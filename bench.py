@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Benchmark: FedAvg round throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measured quantity: fully-jitted vectorized FedAvg rounds/sec (CNN,
+FEMNIST-shaped data, 32 clients/round, 5 local epochs) — the hot path of
+SURVEY.md §3.1. ``vs_baseline`` is the speedup over the reference's
+architecture on the same hardware: a sequential per-client python loop
+with host-side aggregation (what ``fedavg_api.py:102-115`` +
+``_aggregate`` do), implemented with the same jitted per-client step so
+the comparison isolates the *architecture* (vectorize + on-device
+aggregate vs loop + host hops), not torch-vs-jax codegen.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from fedml_tpu.arguments import Arguments
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.data import load
+    from fedml_tpu.simulation import FedAvgAPI
+
+    args = Arguments()
+    for k, v in dict(
+        dataset="femnist",
+        synthetic_train_size=32 * 600,
+        synthetic_test_size=2000,
+        model="cnn",
+        partition_method="hetero",
+        partition_alpha=0.5,
+        client_num_in_total=32,
+        client_num_per_round=32,
+        comm_round=1,
+        epochs=5,
+        batch_size=32,
+        learning_rate=0.03,
+        frequency_of_the_test=10**9,
+        matmul_precision="default",
+    ).items():
+        setattr(args, k, v)
+    args._validate()
+    args = fedml_tpu.init(args)
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    api = FedAvgAPI(args, None, dataset, model)
+
+    packed = dataset.packed_train
+    nsamples = jnp.asarray(dataset.packed_num_samples)
+    idx = jnp.arange(args.client_num_per_round, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    def run_round(params, state, r):
+        return api._round_fn(params, state, packed, nsamples, idx, jax.random.fold_in(rng, r))
+
+    # --- vectorized (this framework's architecture) ---
+    params, state = api.global_params, api.server_state
+    params, state, _ = run_round(params, state, 0)  # compile
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    n_rounds = 10
+    t0 = time.perf_counter()
+    for r in range(1, n_rounds + 1):
+        params, state, _ = run_round(params, state, r)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    vec_rps = n_rounds / (time.perf_counter() - t0)
+
+    # --- baseline: reference architecture (sequential loop + host agg) ---
+    local_j = jax.jit(api._local_train)
+    from fedml_tpu.core.types import Batches
+
+    def seq_round(params, r):
+        host_acc = None
+        ns = []
+        for j in range(args.client_num_per_round):
+            client = Batches(
+                x=packed.x[j], y=packed.y[j], mask=packed.mask[j]
+            )
+            p, _ = local_j(params, client, jax.random.fold_in(rng, r * 1000 + j))
+            # reference hops every client model through host memory
+            # (.cpu().state_dict(), my_model_trainer_classification.py:13)
+            host_p = jax.tree.map(np.asarray, p)
+            w = float(nsamples[j])
+            ns.append(w)
+            if host_acc is None:
+                host_acc = jax.tree.map(lambda a: a * w, host_p)
+            else:
+                host_acc = jax.tree.map(lambda a, b: a + b * w, host_acc, host_p)
+        total = sum(ns)
+        return jax.tree.map(lambda a: jnp.asarray(a / total), host_acc)
+
+    params2 = api.model.init(jax.random.PRNGKey(1))
+    params2 = seq_round(params2, 0)  # compile
+    t0 = time.perf_counter()
+    n_seq = 2
+    for r in range(1, n_seq + 1):
+        params2 = seq_round(params2, r)
+    jax.block_until_ready(jax.tree.leaves(params2)[0])
+    seq_rps = n_seq / (time.perf_counter() - t0)
+
+    samples_per_round = float(np.sum(dataset.packed_num_samples)) * args.epochs
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_rounds_per_sec",
+                "value": round(vec_rps, 4),
+                "unit": "rounds/s (32 clients x 5 epochs, CNN/FEMNIST-shape)",
+                "vs_baseline": round(vec_rps / seq_rps, 2),
+                "detail": {
+                    "sequential_baseline_rounds_per_sec": round(seq_rps, 4),
+                    "client_samples_per_sec": round(vec_rps * samples_per_round, 1),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

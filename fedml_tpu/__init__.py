@@ -1,0 +1,113 @@
+"""fedml_tpu — a TPU-native federated / distributed learning framework.
+
+Brand-new design with the capability surface of FedML v0.7.39
+(reference layout: SURVEY.md; one-line API parity with
+``python/fedml/__init__.py``): ``init()`` -> ``device`` -> ``data`` ->
+``model`` -> scenario ``run()``. Compute is JAX/XLA end-to-end — client
+updates are jitted scans, cohorts are vmapped/mesh-sharded, aggregation
+is an on-device reduction — so the FL round loop never round-trips
+through host pickles the way the reference does.
+"""
+
+from __future__ import annotations
+
+import logging
+import random as _random
+from typing import Optional
+
+import numpy as np
+
+from . import constants  # noqa: F401
+from .arguments import Arguments, load_arguments
+from .core.frame import ClientTrainer, ServerAggregator  # noqa: F401
+
+__version__ = "0.1.0"
+
+_global_training_type: Optional[str] = None
+_global_comm_backend: Optional[str] = None
+
+
+def init(args: Optional[Arguments] = None) -> Arguments:
+    """Parity with ``fedml.init()`` (__init__.py:34-136): load args,
+    seed RNGs, set numeric precision, resolve per-scenario process
+    identity."""
+    if args is None:
+        args = load_arguments(_global_training_type, _global_comm_backend)
+    _seed(int(getattr(args, "random_seed", 0)))
+    import jax
+
+    jax.config.update(
+        "jax_default_matmul_precision",
+        getattr(args, "matmul_precision", "highest"),
+    )
+    logging.getLogger().setLevel(
+        logging.DEBUG if getattr(args, "verbose", False) else logging.INFO
+    )
+    if args.training_type == constants.FEDML_TRAINING_PLATFORM_SIMULATION:
+        args.process_id = 0
+    elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_SILO:
+        args.process_id = int(getattr(args, "rank", 0))
+    elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+        args.rank = 0
+        args.process_id = 0
+    return args
+
+
+def _seed(seed: int) -> None:
+    _random.seed(seed)
+    np.random.seed(seed)
+
+
+def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP) -> None:
+    """One-line simulation entry (__init__.py:139-169)."""
+    global _global_training_type, _global_comm_backend
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_SIMULATION
+    _global_comm_backend = backend
+
+    from . import data, device, models
+    from .simulation import SimulatorMesh, SimulatorSingleProcess
+
+    args = init()
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    if backend in (
+        constants.FEDML_SIMULATION_TYPE_MESH,
+        constants.FEDML_SIMULATION_TYPE_NCCL,
+    ):
+        simulator = SimulatorMesh(args, dev, dataset, model)
+    elif backend == constants.FEDML_SIMULATION_TYPE_SP:
+        simulator = SimulatorSingleProcess(args, dev, dataset, model)
+    else:
+        raise ValueError(f"unknown simulation backend {backend!r}")
+    return simulator.run()
+
+
+def run_cross_silo_server(args: Optional[Arguments] = None):
+    """One-line cross-silo server (__init__.py:172-191)."""
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    from . import data, device, models
+    from .cross_silo import Server
+
+    args = init(args)
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    server = Server(args, dev, dataset, model)
+    return server.run()
+
+
+def run_cross_silo_client(args: Optional[Arguments] = None):
+    """One-line cross-silo client (__init__.py:193-211)."""
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    from . import data, device, models
+    from .cross_silo import Client
+
+    args = init(args)
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    client = Client(args, dev, dataset, model)
+    return client.run()

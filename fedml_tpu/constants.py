@@ -1,0 +1,61 @@
+"""Framework-wide constants.
+
+Parity with the reference's ``python/fedml/constants.py`` (scenario names,
+partition methods, backend names), extended with TPU-native backends.
+"""
+
+FEDML_TRAINING_PLATFORM_SIMULATION = "simulation"
+FEDML_TRAINING_PLATFORM_CROSS_SILO = "cross_silo"
+FEDML_TRAINING_PLATFORM_CROSS_DEVICE = "cross_device"
+FEDML_TRAINING_PLATFORM_DISTRIBUTED = "distributed"
+
+# Simulation sub-backends (reference: simulation/simulator.py:28,43,100).
+# The reference's NCCL simulator is a stub; here "MESH" is the real thing —
+# simulated clients are sharded over a jax.sharding.Mesh and aggregation
+# rides ICI collectives.
+FEDML_SIMULATION_TYPE_SP = "single_process"
+FEDML_SIMULATION_TYPE_MESH = "MESH"
+FEDML_SIMULATION_TYPE_NCCL = "NCCL"  # accepted as an alias of MESH
+
+# Cross-silo scenario hierarchy (reference: constants.py CROSS_SILO_SCENARIO_*)
+FEDML_CROSS_SILO_SCENARIO_HORIZONTAL = "horizontal"
+FEDML_CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
+
+# Communication backends (reference: client_manager.py:27-94 dispatch table).
+COMM_BACKEND_LOCAL = "LOCAL"  # in-process queues (tests / single host)
+COMM_BACKEND_GRPC = "GRPC"
+COMM_BACKEND_MPI = "MPI"  # accepted; mapped onto the LOCAL/GRPC transports
+COMM_BACKEND_MQTT_S3 = "MQTT_S3"
+COMM_BACKEND_SP = "sp"
+COMM_BACKEND_MESH = "MESH"
+
+# Data partition methods (reference: data/cifar10/data_loader.py:122-183)
+PARTITION_HOMO = "homo"
+PARTITION_HETERO = "hetero"
+PARTITION_HETERO_FIX = "hetero-fix"
+
+# Federated optimizers
+FED_OPTIMIZER_FEDAVG = "FedAvg"
+FED_OPTIMIZER_FEDOPT = "FedOpt"
+FED_OPTIMIZER_FEDPROX = "FedProx"
+FED_OPTIMIZER_FEDNOVA = "FedNova"
+
+# Message-protocol constants shared by all FedAvg-family managers
+# (reference: simulation/mpi_p2p_mp/fedavg/message_define.py:1-31).
+MSG_TYPE_S2C_INIT_CONFIG = 1
+MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+MSG_TYPE_C2S_CLIENT_STATUS = 5
+MSG_TYPE_CONNECTION_IS_READY = 0
+
+MSG_ARG_KEY_TYPE = "msg_type"
+MSG_ARG_KEY_SENDER = "sender"
+MSG_ARG_KEY_RECEIVER = "receiver"
+MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+CLIENT_STATUS_ONLINE = "ONLINE"
+CLIENT_STATUS_IDLE = "IDLE"

@@ -1,0 +1,21 @@
+"""Core: functional FL primitives + the transport-agnostic message layer.
+
+Layer map parity (SURVEY.md §1): this package is the union of the
+reference's L1 (communication), L2 (distributed managers), L3 (alg frame)
+and L3b (core services: schedule / robustness / non_iid_partition /
+topology) — rebuilt around pytrees of ``jax.Array`` instead of torch
+state_dicts.
+"""
+
+from .frame import ClientTrainer, ServerAggregator  # noqa: F401
+from .aggregation import (  # noqa: F401
+    stack_pytrees,
+    unstack_pytrees,
+    weighted_average,
+    RobustAggregator,
+)
+from .partition import (  # noqa: F401
+    non_iid_partition_with_dirichlet_distribution,
+    homo_partition,
+    record_data_stats,
+)

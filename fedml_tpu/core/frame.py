@@ -1,0 +1,85 @@
+"""L3 algorithm frame: the framework-agnostic operator pair.
+
+Parity with ``python/fedml/core/alg_frame/client_trainer.py:4-40`` and
+``server_aggregator.py:4-35``: stateless operators holding ``model`` +
+``id`` with get/set params, train, test. Here "params" are pytrees of
+``jax.Array`` instead of torch state_dicts, and the default concrete
+implementations (``fedml_tpu/simulation/trainer.py``) are built from the
+jitted functional core, so custom trainers can still be registered by
+subclassing these ABCs exactly like in the reference.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+Params = Any
+
+
+class ClientTrainer(abc.ABC):
+    """Abstract client operator (client_trainer.py:4-40)."""
+
+    def __init__(self, model, args=None) -> None:
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.local_train_dataset = None
+        self.local_test_dataset = None
+        self.local_sample_number = 0
+
+    def set_id(self, trainer_id) -> None:
+        self.id = trainer_id
+
+    def update_dataset(self, train_data, test_data, sample_num) -> None:
+        self.local_train_dataset = train_data
+        self.local_test_dataset = test_data
+        self.local_sample_number = sample_num
+
+    @abc.abstractmethod
+    def get_model_params(self) -> Params:
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters: Params) -> None:
+        ...
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args) -> None:
+        ...
+
+    def test(self, test_data, device, args):
+        raise NotImplementedError
+
+    def test_on_the_server(self, train_data_local_dict, test_data_local_dict, device, args=None) -> bool:
+        return False
+
+
+class ServerAggregator(abc.ABC):
+    """Abstract server operator (server_aggregator.py:4-35)."""
+
+    def __init__(self, model, args=None) -> None:
+        self.model = model
+        self.id = 0
+        self.args = args
+
+    def set_id(self, aggregator_id) -> None:
+        self.id = aggregator_id
+
+    @abc.abstractmethod
+    def get_model_params(self) -> Params:
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters: Params) -> None:
+        ...
+
+    @abc.abstractmethod
+    def aggregate(self, raw_client_model_list) -> Params:
+        ...
+
+    def test(self, test_data, device, args):
+        raise NotImplementedError
+
+    def test_on_the_server(self, train_data_local_dict, test_data_local_dict, device, args=None) -> bool:
+        return False

@@ -1,0 +1,141 @@
+"""The client-side hot loop: jitted, scan-based local training.
+
+TPU-native replacement for the reference's per-client torch loop
+(``simulation/single_process/fedavg/my_model_trainer_classification.py:18-93``
+— the [HOT LOOP] in SURVEY.md §3.1). Design:
+
+- one ``lax.scan`` over epochs wrapping one ``lax.scan`` over packed
+  batches — a single XLA computation per client round, no Python in the
+  loop, params never leave the device (the reference round-trips through
+  ``.cpu().state_dict()`` every round);
+- fully-masked (padding) batches are skipped exactly: both params and
+  optimizer state are reverted via ``where``, so padded clients match the
+  reference's ragged iteration bit-for-bit under any optimizer;
+- per-epoch reshuffle over the flattened example axis reproduces
+  ``DataLoader(shuffle=True)`` semantics inside jit;
+- the returned function is **vmappable over a leading client axis**
+  (in_axes: params=None, batches=0, rng=0) — that single property turns
+  this one implementation into the sequential simulator (python loop),
+  the vectorized simulator (vmap), and the mesh simulator
+  (shard_map(vmap)) without code changes;
+- optional FedProx proximal term (mu/2 ||w - w_global||^2,
+  ``fedprox`` trainer semantics) so FedProx is a config flag, not a fork.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .types import Batches, flat_examples, rebatch
+
+Params = Any
+
+
+def _shuffle_batches(b: Batches, rng: jax.Array) -> Batches:
+    """Random permutation of the REAL examples, padding kept compacted
+    at the tail: permute, then stable-sort by validity so real examples
+    land (in random order) in the leading slots. This preserves the
+    reference's ``DataLoader(shuffle=True)`` step count — a client with
+    n samples still takes ceil(n/bs) optimizer steps per epoch, and the
+    fully-masked tail batches stay no-ops."""
+    flat = flat_examples(b)
+    n = flat.mask.shape[-1]
+    perm = jax.random.permutation(rng, n)
+    order = jnp.argsort(1.0 - jnp.take(flat.mask, perm, axis=0), stable=True)
+    idx = jnp.take(perm, order, axis=0)
+    shuffled = Batches(
+        x=jnp.take(flat.x, idx, axis=0),
+        y=jnp.take(flat.y, idx, axis=0),
+        mask=jnp.take(flat.mask, idx, axis=0),
+    )
+    return rebatch(shuffled, b.num_batches, b.batch_size)
+
+
+def make_local_train_fn(
+    apply_fn: Callable[[Params, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    optimizer: optax.GradientTransformation,
+    epochs: int,
+    prox_mu: float = 0.0,
+    shuffle: bool = True,
+) -> Callable[[Params, Batches, jax.Array], Tuple[Params, Dict[str, jax.Array]]]:
+    """Build ``local_train(params, batches, rng) -> (new_params, metrics)``.
+
+    ``metrics`` carries the last epoch's summed ``loss_sum`` /
+    ``correct`` / ``count`` so callers can weight by true sample count.
+    """
+
+    def batch_loss(params, global_params, x, y, mask):
+        logits = apply_fn(params, x)
+        loss, metrics = loss_fn(logits, y, mask)
+        if prox_mu > 0.0:
+            sq = sum(
+                jnp.vdot(p - g, p - g)
+                for p, g in zip(jax.tree.leaves(params), jax.tree.leaves(global_params))
+            )
+            loss = loss + 0.5 * prox_mu * sq
+        return loss, metrics
+
+    def local_train(params: Params, batches: Batches, rng: jax.Array):
+        global_params = params
+        opt_state = optimizer.init(params)
+
+        def train_step(carry, batch):
+            p, s = carry
+            x, y, m = batch
+            (loss, metrics), grads = jax.value_and_grad(batch_loss, has_aux=True)(
+                p, global_params, x, y, m
+            )
+            updates, s_new = optimizer.update(grads, s, p)
+            p_new = optax.apply_updates(p, updates)
+            nonempty = m.sum() > 0
+            p = jax.tree.map(lambda a, b2: jnp.where(nonempty, a, b2), p_new, p)
+            s = jax.tree.map(lambda a, b2: jnp.where(nonempty, a, b2), s_new, s)
+            return (p, s), metrics
+
+        def epoch(carry, ep_rng):
+            p, s = carry
+            b = _shuffle_batches(batches, ep_rng) if shuffle else batches
+            (p, s), metrics = jax.lax.scan(train_step, (p, s), (b.x, b.y, b.mask))
+            summed = {
+                "loss_sum": (metrics["loss"] * metrics["count"]).sum(),
+                "correct": metrics["correct"].sum(),
+                "count": metrics["count"].sum(),
+            }
+            return (p, s), summed
+
+        ep_rngs = jax.random.split(rng, epochs)
+        (params, _), per_epoch = jax.lax.scan(epoch, (params, opt_state), ep_rngs)
+        last = jax.tree.map(lambda x: x[-1], per_epoch)
+        return params, last
+
+    return local_train
+
+
+def make_eval_fn(
+    apply_fn: Callable[[Params, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+) -> Callable[[Params, Batches], Dict[str, jax.Array]]:
+    """Build ``evaluate(params, batches) -> summed metrics`` (scan over
+    packed batches; parity with the reference trainers' ``test``,
+    my_model_trainer_classification.py:95-154)."""
+
+    def evaluate(params: Params, batches: Batches) -> Dict[str, jax.Array]:
+        def step(_, batch):
+            x, y, m = batch
+            logits = apply_fn(params, x)
+            loss, metrics = loss_fn(logits, y, m)
+            return None, {
+                "loss_sum": (loss * metrics["count"]),
+                "correct": metrics["correct"],
+                "count": metrics["count"],
+            }
+
+        _, out = jax.lax.scan(step, None, (batches.x, batches.y, batches.mask))
+        return jax.tree.map(lambda x: x.sum(), out)
+
+    return evaluate

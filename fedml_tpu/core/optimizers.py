@@ -1,0 +1,54 @@
+"""Optimizer factory (client- and server-side).
+
+Client side mirrors the reference trainers' SGD/Adam switch
+(``my_model_trainer_classification.py:32-44``). Server side replaces the
+``OptRepo`` reflection hack (``simulation/single_process/fedopt/optrepo.py:7-50``
+— scanning ``torch.optim.Optimizer.__subclasses__()``) with a plain
+name->optax table; FedOpt applies it to the server pseudo-gradient
+(``FedOptAggregator.py:81-130`` semantics).
+"""
+
+from __future__ import annotations
+
+import optax
+
+_CLIENT_OPTS = {
+    "sgd": lambda lr, args: optax.sgd(
+        lr,
+        momentum=(getattr(args, "momentum", 0.0) or None),
+    ),
+    "adam": lambda lr, args: optax.adam(lr),
+    "adamw": lambda lr, args: optax.adamw(
+        lr, weight_decay=getattr(args, "weight_decay", 0.0)
+    ),
+}
+
+
+def create_client_optimizer(args) -> optax.GradientTransformation:
+    name = getattr(args, "client_optimizer", "sgd").lower()
+    if name not in _CLIENT_OPTS:
+        raise ValueError(f"unknown client_optimizer {name!r}")
+    wd = float(getattr(args, "weight_decay", 0.0) or 0.0)
+    tx = _CLIENT_OPTS[name](float(args.learning_rate), args)
+    if name == "sgd" and wd > 0.0:
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    return tx
+
+
+_SERVER_OPTS = {
+    "sgd": lambda lr, args: optax.sgd(
+        lr, momentum=(getattr(args, "server_momentum", 0.0) or None)
+    ),
+    "adam": lambda lr, args: optax.adam(
+        lr, b1=getattr(args, "server_beta1", 0.9), b2=getattr(args, "server_beta2", 0.999)
+    ),
+    "adagrad": lambda lr, args: optax.adagrad(lr),
+    "yogi": lambda lr, args: optax.yogi(lr),
+}
+
+
+def create_server_optimizer(args) -> optax.GradientTransformation:
+    name = getattr(args, "server_optimizer", "sgd").lower()
+    if name not in _SERVER_OPTS:
+        raise ValueError(f"unknown server_optimizer {name!r}")
+    return _SERVER_OPTS[name](float(getattr(args, "server_lr", 1.0)), args)

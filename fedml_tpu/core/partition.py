@@ -1,0 +1,106 @@
+"""Non-IID data partitioning.
+
+Port of the reference's Latent-Dirichlet partitioner
+(``python/fedml/core/non_iid_partition/noniid_partition.py:6-109``):
+per-class Dirichlet(alpha) allocation across clients, with the
+min-10-samples retry loop (noniid_partition.py:41-43), plus the ``homo``
+uniform split used by the dataset-local partitioners
+(``data/cifar10/data_loader.py:122-183``).
+
+Numpy-side (runs once on host at data-load time); the result feeds the
+static-shape packer in ``fedml_tpu/data/packing.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+import numpy as np
+
+
+def partition_class_samples_with_dirichlet_distribution(
+    N: int,
+    alpha: float,
+    client_num: int,
+    idx_batch: List[List[int]],
+    idx_k: np.ndarray,
+    rng: np.random.RandomState,
+):
+    """One class's allocation (noniid_partition.py:81-109): draw
+    Dirichlet(alpha) proportions, zero out clients already holding >= N/n
+    samples (balance guard), split the class's shuffled indices."""
+    rng.shuffle(idx_k)
+    proportions = rng.dirichlet(np.repeat(alpha, client_num))
+    proportions = np.array(
+        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)]
+    )
+    proportions = proportions / proportions.sum()
+    proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+    idx_batch = [
+        idx_j + idx.tolist()
+        for idx_j, idx in zip(idx_batch, np.split(idx_k, proportions))
+    ]
+    min_size = min(len(idx_j) for idx_j in idx_batch)
+    return idx_batch, min_size
+
+
+def non_iid_partition_with_dirichlet_distribution(
+    label_list: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: float,
+    task: str = "classification",
+    seed: int = 0,
+) -> Dict[int, np.ndarray]:
+    """LDA partition (noniid_partition.py:6-78). Returns
+    {client_idx: sample index array}. Retries until every client has
+    >= 10 samples (noniid_partition.py:41-43)."""
+    net_dataidx_map: Dict[int, np.ndarray] = {}
+    rng = np.random.RandomState(seed)
+    min_size = 0
+    N = len(label_list)
+    while min_size < 10:
+        idx_batch: List[List[int]] = [[] for _ in range(client_num)]
+        if task == "segmentation":
+            # multi-label: label_list is [classes, ...] of index arrays
+            for k in range(classes):
+                idx_k = np.asarray(label_list[k])
+                idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
+                    N, alpha, client_num, idx_batch, idx_k, rng
+                )
+        else:
+            for k in range(classes):
+                idx_k = np.where(np.asarray(label_list) == k)[0]
+                idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
+                    N, alpha, client_num, idx_batch, idx_k, rng
+                )
+    for i in range(client_num):
+        rng.shuffle(idx_batch[i])
+        net_dataidx_map[i] = np.array(idx_batch[i], dtype=np.int64)
+    return net_dataidx_map
+
+
+def homo_partition(
+    n_samples: int, client_num: int, seed: int = 0
+) -> Dict[int, np.ndarray]:
+    """IID split (cifar10/data_loader.py ``homo`` branch): shuffle and
+    slice into equal shards."""
+    rng = np.random.RandomState(seed)
+    idxs = rng.permutation(n_samples)
+    return {
+        i: np.sort(shard).astype(np.int64)
+        for i, shard in enumerate(np.array_split(idxs, client_num))
+    }
+
+
+def record_data_stats(
+    y_train: np.ndarray, net_dataidx_map: Dict[int, np.ndarray], task="classification"
+) -> Dict[int, Dict[int, int]]:
+    """Per-client class histogram (noniid_partition.py:112-124)."""
+    net_cls_counts: Dict[int, Dict[int, int]] = {}
+    for net_i, dataidx in net_dataidx_map.items():
+        unq, unq_cnt = np.unique(np.asarray(y_train)[dataidx], return_counts=True)
+        net_cls_counts[net_i] = {int(u): int(c) for u, c in zip(unq, unq_cnt)}
+    logging.debug("Data statistics: %s", net_cls_counts)
+    return net_cls_counts

@@ -1,0 +1,72 @@
+"""Device-side data containers.
+
+The reference feeds ragged torch ``DataLoader``s per client
+(``data/MNIST/data_loader.py:75-99``). XLA wants static shapes, so a
+client's dataset is packed once into ``[num_batches, batch_size, ...]``
+arrays with a validity mask; a federation of clients adds a leading
+client axis ``C``. The same container therefore describes one client
+(inside a train step), a vmap batch of clients, or a mesh-sharded shard —
+only the leading axes differ.
+
+Layout convention:
+  - ``mask``: [..., nb, bs] in {0, 1}
+  - ``x``:    [..., nb, bs, *feature_dims]
+  - ``y``:    [..., nb, bs, *label_dims]  (label_dims empty for class ids)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from flax import struct
+
+
+@struct.dataclass
+class Batches:
+    x: jax.Array
+    y: jax.Array
+    mask: jax.Array
+
+    @property
+    def num_batches(self) -> int:
+        return self.mask.shape[-2]
+
+    @property
+    def batch_size(self) -> int:
+        return self.mask.shape[-1]
+
+    def num_samples(self) -> jax.Array:
+        return self.mask.sum(axis=(-1, -2))
+
+
+@struct.dataclass
+class ClientDataset:
+    """One client's (or one stacked federation's) packed splits."""
+
+    train: Batches
+    test: Optional[Batches] = None
+
+
+def flat_examples(b: Batches) -> Batches:
+    """Collapse the [nb, bs] batch axes into one [nb*bs] example axis
+    (used for per-epoch reshuffling and full-batch eval)."""
+    lead = b.mask.shape[:-2]
+    n = b.num_batches * b.batch_size
+
+    def rs(a: jax.Array) -> jax.Array:
+        feat = a.shape[len(lead) + 2:]
+        return a.reshape(lead + (n,) + feat)
+
+    return Batches(x=rs(b.x), y=rs(b.y), mask=rs(b.mask))
+
+
+def rebatch(b: Batches, num_batches: int, batch_size: int) -> Batches:
+    """Inverse of ``flat_examples``."""
+    lead = b.mask.shape[:-1]
+
+    def rs(a: jax.Array) -> jax.Array:
+        feat = a.shape[len(lead) + 1:]
+        return a.reshape(lead + (num_batches, batch_size) + feat)
+
+    return Batches(x=rs(b.x), y=rs(b.y), mask=rs(b.mask))

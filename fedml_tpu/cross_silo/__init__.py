@@ -1,0 +1,27 @@
+"""Cross-silo scenario ("Octopus" parity, SURVEY.md §2.10).
+
+The message-layer milestone lands the real ``Client`` / ``Server``
+(gRPC + in-process transports, presence handshake, client-id
+indirection). Until then the one-line entry points fail with a clear
+error instead of an ImportError.
+"""
+
+from __future__ import annotations
+
+
+class _NotYet:
+    _msg = (
+        "cross-silo is not available yet in this build; "
+        "use fedml_tpu.run_simulation() (simulation scenario)"
+    )
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(self._msg)
+
+
+class Client(_NotYet):
+    pass
+
+
+class Server(_NotYet):
+    pass

@@ -1,0 +1,3 @@
+"""Data layer: ``fedml_tpu.data.load(args)``."""
+
+from .loader import FederatedDataset, load  # noqa: F401

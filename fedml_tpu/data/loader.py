@@ -1,0 +1,212 @@
+"""Dataset dispatcher.
+
+``load(args)`` mirrors ``fedml.data.load`` (``python/fedml/data/
+data_loader.py:29`` -> ``load_synthetic_data`` ``:42-320``) and returns a
+:class:`FederatedDataset` whose ``to_list()`` is the reference's
+canonical 8-tuple ``[train_data_num, test_data_num, train_data_global,
+test_data_global, train_data_local_num_dict, train_data_local_dict,
+test_data_local_dict, class_num]`` (data_loader.py:310-320) — plus the
+device-side packed federation (``packed_train`` / ``packed_test``,
+leaves ``[C, nb, bs, ...]``) that the TPU simulators consume.
+
+Dataset resolution: real files in ``args.data_cache_dir`` when present
+(LEAF-style .npz per split), otherwise a synthetic stand-in with the
+real dataset's shapes/classes (this environment has no egress; the
+reference downloads from S3, ``data/MNIST/data_loader.py:17-29``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+from ..core.partition import (
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+    record_data_stats,
+)
+from ..core.types import Batches
+from .packing import bucket_num_batches, pack_clients, pack_one
+from .synthetic import (
+    synthetic_classification,
+    synthetic_fedprox,
+    synthetic_sequences,
+)
+
+_DATASET_META = {
+    # name: (feature_shape, class_num, train_n, test_n, task)
+    "mnist": ((28, 28, 1), 10, 60000, 10000, "classification"),
+    "femnist": ((28, 28, 1), 62, 40000, 8000, "classification"),
+    "fashion_mnist": ((28, 28, 1), 10, 60000, 10000, "classification"),
+    "cifar10": ((32, 32, 3), 10, 50000, 10000, "classification"),
+    "cifar100": ((32, 32, 3), 100, 50000, 10000, "classification"),
+    "fed_cifar100": ((32, 32, 3), 100, 50000, 10000, "classification"),
+    "cinic10": ((32, 32, 3), 10, 90000, 90000, "classification"),
+    "shakespeare": ((80,), 90, 16000, 2000, "nwp"),
+    "fed_shakespeare": ((80,), 90, 16000, 2000, "nwp"),
+    "stackoverflow_nwp": ((20,), 10004, 40000, 8000, "nwp"),
+}
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    train_data_num: int
+    test_data_num: int
+    train_data_global: Batches
+    test_data_global: Batches
+    train_data_local_num_dict: Dict[int, int]
+    train_data_local_dict: Dict[int, Batches]
+    test_data_local_dict: Dict[int, Optional[Batches]]
+    class_num: int
+    # TPU-side stacked federation (client axis leading)
+    packed_train: Batches = None
+    packed_num_samples: np.ndarray = None
+    packed_test: Optional[Batches] = None
+    client_num: int = 0
+    task: str = "classification"
+
+    def to_list(self) -> List:
+        """Reference 8-tuple (data_loader.py:310-320)."""
+        return [
+            self.train_data_num,
+            self.test_data_num,
+            self.train_data_global,
+            self.test_data_global,
+            self.train_data_local_num_dict,
+            self.train_data_local_dict,
+            self.test_data_local_dict,
+            self.class_num,
+        ]
+
+
+def _try_load_real(name: str, cache_dir: str):
+    """Real data drop-in: <cache>/<name>/{train,test}.npz with x,y."""
+    d = os.path.join(cache_dir or "", name)
+    tr, te = os.path.join(d, "train.npz"), os.path.join(d, "test.npz")
+    if os.path.exists(tr) and os.path.exists(te):
+        a, b = np.load(tr), np.load(te)
+        return (a["x"], a["y"], b["x"], b["y"])
+    return None
+
+
+def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, str]:
+    name = getattr(args, "dataset", "synthetic").lower()
+    seed = int(getattr(args, "random_seed", 0))
+    if name.startswith("synthetic"):
+        # FedProx synthetic(alpha,beta): natively federated — handled by caller
+        raise RuntimeError("synthetic handled separately")
+    if name not in _DATASET_META:
+        raise ValueError(f"unknown dataset {name!r}")
+    shape, class_num, train_n, test_n, task = _DATASET_META[name]
+    real = _try_load_real(name, getattr(args, "data_cache_dir", None))
+    if real is not None:
+        x_tr, y_tr, x_te, y_te = real
+        return x_tr, y_tr, x_te, y_te, class_num, task
+    logging.warning(
+        "dataset %s: no local copy under data_cache_dir; using synthetic "
+        "stand-in with identical shapes/classes",
+        name,
+    )
+    train_n = int(getattr(args, "synthetic_train_size", min(train_n, 20000)))
+    test_n = int(getattr(args, "synthetic_test_size", min(test_n, 4000)))
+    if task == "nwp":
+        seq_len, vocab = shape[0], class_num
+        x_tr, y_tr = synthetic_sequences(train_n, seq_len, vocab, seed)
+        x_te, y_te = synthetic_sequences(test_n, seq_len, vocab, seed + 1)
+    else:
+        x_tr, y_tr = synthetic_classification(train_n, class_num, shape, seed)
+        x_te, y_te = synthetic_classification(test_n, class_num, shape, seed + 1)
+    return x_tr, y_tr, x_te, y_te, class_num, task
+
+
+def load(args) -> FederatedDataset:
+    """Load + partition + pack (data_loader.py:29 entry)."""
+    name = getattr(args, "dataset", "synthetic").lower()
+    client_num = int(args.client_num_in_total)
+    batch_size = int(args.batch_size)
+    seed = int(getattr(args, "random_seed", 0))
+
+    if name.startswith("synthetic"):
+        xs, ys = synthetic_fedprox(
+            num_clients=client_num,
+            alpha=float(getattr(args, "synthetic_alpha", 1.0)),
+            beta=float(getattr(args, "synthetic_beta", 1.0)),
+            input_dim=int(getattr(args, "input_dim", 60)),
+            num_classes=int(getattr(args, "output_dim", 10)),
+            seed=seed,
+        )
+        class_num = int(getattr(args, "output_dim", 10))
+        task = "classification"
+        # 80/20 split per client
+        xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+        for x, y in zip(xs, ys):
+            k = max(1, int(0.8 * len(x)))
+            xs_tr.append(x[:k]); ys_tr.append(y[:k])
+            xs_te.append(x[k:]); ys_te.append(y[k:])
+    else:
+        x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
+        method = getattr(args, "partition_method", constants.PARTITION_HETERO)
+        if method == constants.PARTITION_HOMO:
+            idx_map = homo_partition(len(y_tr), client_num, seed)
+        else:
+            idx_map = non_iid_partition_with_dirichlet_distribution(
+                y_tr, client_num, class_num,
+                float(getattr(args, "partition_alpha", 0.5)), seed=seed,
+            )
+        record_data_stats(y_tr, idx_map)
+        xs_tr = [x_tr[idx_map[i]] for i in range(client_num)]
+        ys_tr = [y_tr[idx_map[i]] for i in range(client_num)]
+        # test side: shard uniformly (reference gives each client a
+        # local test loader over the global test set slice)
+        te_map = homo_partition(len(y_te), client_num, seed + 1)
+        xs_te = [x_te[te_map[i]] for i in range(client_num)]
+        ys_te = [y_te[te_map[i]] for i in range(client_num)]
+
+    import jax.numpy as jnp
+
+    x_dtype = jnp.int32 if task == "nwp" else jnp.float32
+
+    sizes = [len(x) for x in xs_tr]
+    nb = bucket_num_batches(sizes, batch_size)
+    packed_train, num_samples = pack_clients(
+        xs_tr, ys_tr, batch_size, num_batches=nb, x_dtype=x_dtype
+    )
+    nb_te = bucket_num_batches([len(x) for x in xs_te], batch_size)
+    packed_test, _ = pack_clients(
+        xs_te, ys_te, batch_size, num_batches=nb_te, x_dtype=x_dtype
+    )
+
+    x_tr_all = np.concatenate(xs_tr)
+    y_tr_all = np.concatenate(ys_tr)
+    x_te_all = np.concatenate(xs_te)
+    y_te_all = np.concatenate(ys_te)
+    train_global = pack_one(x_tr_all, y_tr_all, batch_size, x_dtype=x_dtype)
+    test_global = pack_one(x_te_all, y_te_all, batch_size, x_dtype=x_dtype)
+
+    local_train = {i: _client_view(packed_train, i) for i in range(client_num)}
+    local_test = {i: _client_view(packed_test, i) for i in range(client_num)}
+
+    return FederatedDataset(
+        train_data_num=int(sum(sizes)),
+        test_data_num=int(len(y_te_all)),
+        train_data_global=train_global,
+        test_data_global=test_global,
+        train_data_local_num_dict={i: int(s) for i, s in enumerate(sizes)},
+        train_data_local_dict=local_train,
+        test_data_local_dict=local_test,
+        class_num=class_num,
+        packed_train=packed_train,
+        packed_num_samples=np.asarray(num_samples),
+        packed_test=packed_test,
+        client_num=client_num,
+        task=task,
+    )
+
+
+def _client_view(stacked: Batches, i: int) -> Batches:
+    return Batches(x=stacked.x[i], y=stacked.y[i], mask=stacked.mask[i])

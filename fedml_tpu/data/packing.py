@@ -1,0 +1,97 @@
+"""Host-side packing: ragged per-client numpy data -> static-shape
+device arrays.
+
+This is the load-bearing bridge between the reference's ragged
+torch-DataLoader world and XLA's static shapes (SURVEY.md §7 "hard
+parts": padded/bucketed client batching). Each client's samples are
+padded up to ``num_batches * batch_size`` with a {0,1} mask; a
+federation is stacked along a leading client axis so the whole cohort is
+ONE pytree — ready for vmap or for sharding the client axis over a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.types import Batches
+
+
+def pack_one(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    num_batches: Optional[int] = None,
+    x_dtype=jnp.float32,
+    y_dtype=None,
+    allow_truncate: bool = False,
+) -> Batches:
+    """Pack one client's samples into [nb, bs, ...] + mask.
+
+    ``allow_truncate``: keep only the first ``num_batches*batch_size``
+    samples (used by ``pack_clients`` when the bucketing heuristic caps
+    a long-tail client)."""
+    n = x.shape[0]
+    nb = num_batches if num_batches is not None else max(1, -(-n // batch_size))
+    total = nb * batch_size
+    if n > total:
+        if not allow_truncate:
+            raise ValueError(f"num_batches={nb} too small for {n} samples")
+        x, y, n = x[:total], y[:total], total
+    pad = total - n
+    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+    yp = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)]) if pad else y
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    if y_dtype is None:
+        y_dtype = jnp.int32 if np.issubdtype(y.dtype, np.integer) else jnp.float32
+    feat_x = x.shape[1:]
+    feat_y = y.shape[1:]
+    return Batches(
+        x=jnp.asarray(xp.reshape((nb, batch_size) + feat_x), dtype=x_dtype),
+        y=jnp.asarray(yp.reshape((nb, batch_size) + feat_y), dtype=y_dtype),
+        mask=jnp.asarray(mask.reshape(nb, batch_size)),
+    )
+
+
+def pack_clients(
+    xs: Sequence[np.ndarray],
+    ys: Sequence[np.ndarray],
+    batch_size: int,
+    num_batches: Optional[int] = None,
+    x_dtype=jnp.float32,
+) -> Tuple[Batches, jnp.ndarray]:
+    """Pack a federation: all clients padded to a common ``num_batches``
+    (max over clients unless given) and stacked -> leaves [C, nb, bs, ...].
+
+    Returns (stacked_batches, num_samples[C]). The shared nb is what
+    makes the cohort vmap-able; the mask keeps ragged semantics exact.
+    """
+    if num_batches is None:
+        num_batches = max(max(1, -(-len(x) // batch_size)) for x in xs)
+    packed = [
+        pack_one(x, y, batch_size, num_batches, x_dtype=x_dtype, allow_truncate=True)
+        for x, y in zip(xs, ys)
+    ]
+    stacked = Batches(
+        x=jnp.stack([p.x for p in packed]),
+        y=jnp.stack([p.y for p in packed]),
+        mask=jnp.stack([p.mask for p in packed]),
+    )
+    # weights reflect the samples actually packed (long-tail clients may
+    # have been truncated to num_batches*batch_size)
+    cap = num_batches * batch_size
+    num_samples = jnp.asarray(
+        [min(len(x), cap) for x in xs], dtype=jnp.float32
+    )
+    return stacked, num_samples
+
+
+def bucket_num_batches(sizes: List[int], batch_size: int, waste_cap: float = 4.0) -> int:
+    """Heuristic shared nb: cap padding waste by clamping to
+    ``waste_cap`` x median client size (huge-client tail gets truncated
+    batches dropped rather than blowing up every client's padding)."""
+    nbs = [max(1, -(-s // batch_size)) for s in sizes]
+    med = float(np.median(nbs))
+    return int(min(max(nbs), max(1.0, waste_cap * med)))

@@ -1,0 +1,99 @@
+"""Synthetic dataset generators.
+
+Two roles:
+
+1. Parity with the reference's synthetic federated datasets
+   (``python/fedml/data/synthetic_1_1``, ``data/fedprox`` — the FedProx
+   synthetic(alpha, beta) generator): per-client logistic models drawn
+   from a hierarchical Gaussian, the standard non-IID stress test.
+2. Zero-egress stand-ins for download-only datasets (the reference
+   auto-downloads MNIST et al. from S3, ``data/MNIST/data_loader.py:17-29``;
+   this environment has no egress). Shapes/classes match the real
+   datasets so models and benchmarks are identical; a real copy placed
+   in ``args.data_cache_dir`` takes precedence (see loader.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def synthetic_fedprox(
+    num_clients: int = 30,
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    input_dim: int = 60,
+    num_classes: int = 10,
+    seed: int = 0,
+    min_samples: int = 20,
+    max_samples: int = 400,
+) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """FedProx synthetic(alpha, beta): W_k ~ N(u_k, 1), u_k ~ N(0, alpha);
+    x_k ~ N(v_k, Sigma), v_k ~ N(B_k, 1), B_k ~ N(0, beta); lognormal
+    client sizes. Returns per-client (x, y) lists."""
+    rng = np.random.RandomState(seed)
+    sizes = np.clip(
+        rng.lognormal(4, 2, num_clients).astype(int), min_samples, max_samples
+    )
+    diag = np.array([(j + 1) ** -1.2 for j in range(input_dim)])
+    xs, ys = [], []
+    for k in range(num_clients):
+        u_k = rng.normal(0, alpha)
+        b_k = rng.normal(0, beta)
+        v_k = rng.normal(b_k, 1, input_dim)
+        W = rng.normal(u_k, 1, (input_dim, num_classes))
+        b = rng.normal(u_k, 1, num_classes)
+        x = rng.multivariate_normal(v_k, np.diag(diag), sizes[k]).astype(np.float32)
+        logits = x @ W + b
+        y = np.argmax(logits, axis=1).astype(np.int64)
+        xs.append(x)
+        ys.append(y)
+    return xs, ys
+
+
+def synthetic_classification(
+    n_samples: int,
+    num_classes: int,
+    feature_shape: Tuple[int, ...],
+    seed: int = 0,
+    sigma: float = 1.0,
+    means_seed: int = 1234,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional Gaussian blobs with learnable structure: each
+    class has a mean vector; examples are mean + noise. Linear models
+    reach high accuracy, so optimization dynamics are observable.
+
+    ``means_seed`` fixes the class means independently of the sampling
+    seed so train/test splits share one distribution."""
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(feature_shape))
+    means = np.random.RandomState(means_seed).normal(
+        0, 1, (num_classes, dim)
+    ).astype(np.float32)
+    y = rng.randint(0, num_classes, n_samples).astype(np.int64)
+    x = means[y] + sigma * rng.normal(0, 1, (n_samples, dim)).astype(np.float32)
+    return x.reshape((n_samples,) + feature_shape), y
+
+
+def synthetic_sequences(
+    n_samples: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Markov-chain token streams for NWP models: x = tokens[:-1],
+    y = tokens[1:]. The chain's structure makes next-token prediction
+    learnable above chance."""
+    rng = np.random.RandomState(seed)
+    # sparse row-stochastic transition matrix
+    trans = rng.dirichlet(np.full(vocab_size, 0.05), size=vocab_size)
+    toks = np.zeros((n_samples, seq_len + 1), np.int64)
+    toks[:, 0] = rng.randint(0, vocab_size, n_samples)
+    for t in range(seq_len):
+        p = trans[toks[:, t]]
+        cum = p.cumsum(axis=1)
+        u = rng.rand(n_samples, 1)
+        toks[:, t + 1] = (u > cum).sum(axis=1)
+    return toks[:, :-1], toks[:, 1:]

@@ -1,0 +1,33 @@
+"""Device management (reference: ``python/fedml/device/device.py:8-58``).
+
+``get_device(args)`` resolves the accelerator per scenario. The
+reference maps MPI ranks onto GPUs from a YAML table
+(``gpu_mapping.py:8-76``); here device discovery is ``jax.devices()``
+and multi-chip placement is a mesh (``fedml_tpu.parallel.mesh``), so
+this layer only picks the default device and reports topology.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import jax
+
+
+def get_device(args):
+    """Return the default device (single-chip scenarios) — mesh
+    scenarios build their own Mesh from all devices."""
+    devices = jax.devices()
+    logging.info(
+        "devices: %d x %s", len(devices), getattr(devices[0], "device_kind", "?")
+    )
+    return devices[0]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def topology() -> List[str]:
+    return [str(d) for d in jax.devices()]

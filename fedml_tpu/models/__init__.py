@@ -1,0 +1,105 @@
+"""Model zoo + factory.
+
+``create(args, output_dim)`` mirrors ``fedml.model.create``
+(``python/fedml/model/model_hub.py:13-53``): dispatch keyed on
+``(args.model, args.dataset)``, returning a :class:`FedModel` handle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .spec import FedModel
+from .linear import LogisticRegression, MLP
+from .cnn import CNNFedAvg, CNNCifar
+from .resnet import resnet18_gn, resnet56
+from .rnn import RNNOriginalFedAvg, RNNStackOverflow
+
+__all__ = ["FedModel", "create"]
+
+_IMAGE_SHAPES = {
+    "mnist": (28, 28, 1),
+    "femnist": (28, 28, 1),
+    "fashion_mnist": (28, 28, 1),
+    "cifar10": (32, 32, 3),
+    "cifar100": (32, 32, 3),
+    "cinic10": (32, 32, 3),
+    "fed_cifar100": (32, 32, 3),
+}
+
+
+def _example_shape(args, default=(28, 28, 1)):
+    ds = getattr(args, "dataset", "synthetic")
+    if ds == "synthetic":
+        dim = int(getattr(args, "input_dim", 60))
+        return (dim,)
+    return _IMAGE_SHAPES.get(ds, default)
+
+
+def create(args, output_dim: int) -> FedModel:
+    """Factory (model_hub.py:13-53 semantics)."""
+    name = getattr(args, "model", "lr").lower()
+    ds = getattr(args, "dataset", "synthetic").lower()
+
+    if name == "lr":
+        return FedModel(
+            name="lr",
+            module=LogisticRegression(output_dim),
+            task="classification",
+            example_shape=_example_shape(args),
+        )
+    if name == "mlp":
+        hidden = int(getattr(args, "hidden_dim", 64))
+        return FedModel(
+            name="mlp",
+            module=MLP(hidden, output_dim),
+            task="classification",
+            example_shape=_example_shape(args),
+        )
+    if name == "cnn":
+        if ds in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
+            return FedModel(
+                name="cnn_cifar",
+                module=CNNCifar(output_dim),
+                task="classification",
+                example_shape=(32, 32, 3),
+            )
+        return FedModel(
+            name="cnn",
+            module=CNNFedAvg(output_dim),
+            task="classification",
+            example_shape=(28, 28, 1),
+        )
+    if name in ("resnet18", "resnet18_gn"):
+        return FedModel(
+            name="resnet18_gn",
+            module=resnet18_gn(output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name in ("resnet56", "resnet"):
+        return FedModel(
+            name="resnet56",
+            module=resnet56(output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name == "rnn":
+        if "stackoverflow" in ds:
+            vocab = int(getattr(args, "vocab_size", 10004))
+            return FedModel(
+                name="rnn_stackoverflow",
+                module=RNNStackOverflow(vocab_size=vocab),
+                task="nwp",
+                example_shape=(int(getattr(args, "seq_len", 20)),),
+                example_dtype=jnp.int32,
+            )
+        vocab = int(getattr(args, "vocab_size", 90))
+        return FedModel(
+            name="rnn_fedavg",
+            module=RNNOriginalFedAvg(vocab_size=vocab),
+            task="nwp",
+            example_shape=(int(getattr(args, "seq_len", 80)),),
+            example_dtype=jnp.int32,
+        )
+    raise ValueError(f"model {name!r} (dataset {ds!r}) not in the model hub")

@@ -1,0 +1,51 @@
+"""FedAvg CNNs (reference: ``python/fedml/model/cv/cnn.py``).
+
+``CNN_DropOut`` there is the 'Adaptive Federated Optimization' FEMNIST
+net: conv3x3(32) -> maxpool -> conv3x3(64) -> maxpool -> fc128 -> out,
+with dropout. Dropout is omitted here (deterministic apply keeps the
+client update a pure function of (params, batch, rng) without threading
+a second rng collection); the reference's own benchmark runs are
+insensitive to it at FEMNIST scale.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class CNNFedAvg(nn.Module):
+    """2-conv CNN for 28x28 grayscale (MNIST/FEMNIST). NHWC."""
+
+    output_dim: int = 62
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:  # [B, H, W] -> [B, H, W, 1]
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.output_dim)(x)
+
+
+class CNNCifar(nn.Module):
+    """Small CIFAR CNN (reference ``cv/cnn.py`` CIFAR variant): 3x conv
+    blocks + fc."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for ch in (32, 64, 64):
+            x = nn.Conv(ch, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.output_dim)(x)

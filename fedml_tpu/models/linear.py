@@ -1,0 +1,32 @@
+"""Linear models (reference: ``python/fedml/model/linear/lr.py``)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """LR as in ``model/linear/lr.py`` (a single Linear; sigmoid/softmax
+    lives in the loss). Flattens trailing feature dims so image inputs
+    work unchanged."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        return nn.Dense(self.output_dim)(x)
+
+
+class MLP(nn.Module):
+    """Two-layer perceptron baseline (used by synthetic benchmarks)."""
+
+    hidden_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(self.hidden_dim)(x))
+        return nn.Dense(self.output_dim)(x)

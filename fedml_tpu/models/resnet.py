@@ -1,0 +1,95 @@
+"""ResNets with GroupNorm.
+
+Reference models: ``python/fedml/model/cv/resnet_gn.py`` (ResNet-18 +
+GroupNorm for fed_cifar100, the 'Adaptive Federated Optimization'
+architecture) and ``python/fedml/model/cv/resnet.py`` (ResNet-56 for the
+BENCHMARK_MPI modern-DNN table). The -56 variant uses BatchNorm in the
+reference; here every norm is GroupNorm so that *all* leaves of the
+param pytree are true parameters — no running stats to special-case in
+aggregation (the reference has to skip them, robust_aggregation.py:30-38)
+and no mutable collections inside the jitted client update. GN is the
+standard FL substitution (Hsieh et al., "non-IID data quagmire").
+
+NHWC layout (TPU-native; conv lowers to MXU with channels-last).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(channels: int) -> nn.GroupNorm:
+    return nn.GroupNorm(num_groups=min(32, channels))
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.channels, (3, 3), strides=(self.strides, self.strides), use_bias=False)(x)
+        y = _gn(self.channels)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), use_bias=False)(y)
+        y = _gn(self.channels)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.channels, (1, 1), strides=(self.strides, self.strides), use_bias=False
+            )(x)
+            residual = _gn(self.channels)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Stage-configurable GN ResNet."""
+
+    stage_sizes: Sequence[int]
+    stage_channels: Sequence[int]
+    output_dim: int
+    stem_kernel: int = 3
+    stem_pool: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        ch0 = self.stage_channels[0]
+        k = self.stem_kernel
+        x = nn.Conv(ch0, (k, k), strides=(2, 2) if self.stem_pool else (1, 1), use_bias=False)(x)
+        x = _gn(ch0)(x)
+        x = nn.relu(x)
+        if self.stem_pool:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, (size, ch) in enumerate(zip(self.stage_sizes, self.stage_channels)):
+            for j in range(size):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = BasicBlock(ch, strides)(x, train)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.output_dim)(x)
+
+
+def resnet18_gn(output_dim: int) -> ResNet:
+    """ResNet-18 + GN (resnet_gn.py; fed_cifar100 benchmark model)."""
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        stage_channels=(64, 128, 256, 512),
+        output_dim=output_dim,
+        stem_kernel=3,
+        stem_pool=False,
+    )
+
+
+def resnet56(output_dim: int) -> ResNet:
+    """ResNet-56 CIFAR variant (resnet.py; BENCHMARK_MPI table): 3 stages
+    x 9 basic blocks, 16/32/64 channels."""
+    return ResNet(
+        stage_sizes=(9, 9, 9),
+        stage_channels=(16, 32, 64),
+        output_dim=output_dim,
+        stem_kernel=3,
+        stem_pool=False,
+    )

@@ -1,0 +1,43 @@
+"""Federated RNNs (reference: ``python/fedml/model/nlp/rnn.py``).
+
+- ``RNNOriginalFedAvg``: the McMahan et al. shakespeare char-LM —
+  embedding(8) -> 2x LSTM(256) -> dense(vocab) (rnn.py
+  ``RNN_OriginalFedAvg``).
+- ``RNNStackOverflow``: stackoverflow NWP — embedding(96) ->
+  LSTM(670) -> dense(96) -> dense(vocab) (rnn.py ``RNN_StackOverFlow``).
+
+Sequence processing uses ``flax.linen.RNN`` over
+``OptimizedLSTMCell`` — an ``lax.scan`` over time, static sequence
+length, so the whole client update stays one fused XLA computation.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [B, T] int tokens -> logits [B, T, V]
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10004  # 10000 + pad/bos/eos/oov
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

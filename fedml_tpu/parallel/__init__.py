@@ -1,0 +1,3 @@
+"""Parallelism layer: mesh construction, sharded FL, in-silo SPMD."""
+
+from .mesh import build_mesh, shard_federation, replicate  # noqa: F401

@@ -1,0 +1,104 @@
+"""Device-mesh construction and federation sharding.
+
+TPU-native replacement for the reference's device layer
+(``python/fedml/device/gpu_mapping.py:8-76`` maps MPI ranks to GPUs from
+a YAML table): here placement is a ``jax.sharding.Mesh`` over the slice,
+discovered from ``jax.devices()``, and "mapping clients to devices" is a
+``NamedSharding`` on the leading client axis of the packed federation.
+XLA then partitions the vmapped client-update across chips and turns the
+FedAvg weighted reduction into an ICI all-reduce — the design SURVEY.md
+§7 step 4 calls "the NCCL-stub done right" (the reference's
+``SimulatorNCCL`` is an empty stub, simulation/simulator.py:100-108).
+
+Mesh axes convention (2D by default):
+  - ``clients``: FL process-parallelism — each group of chips trains a
+    disjoint shard of the sampled cohort;
+  - ``data``: in-client data parallelism — a client's per-batch examples
+    are sharded within the group (the reference's in-silo DDP analog,
+    §2.10 hierarchical cross-silo).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.types import Batches
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[dict] = None,
+) -> Mesh:
+    """Build a Mesh from slice topology. ``mesh_shape`` e.g.
+    ``{"clients": 4, "data": 2}``; default: all devices on ``clients``."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not mesh_shape:
+        mesh_shape = {"clients": n}
+    axis_names = tuple(mesh_shape.keys())
+    shape = tuple(int(v) for v in mesh_shape.values())
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {mesh_shape} != {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def federation_spec(mesh: Mesh) -> P:
+    """PartitionSpec for packed-federation leaves [C, nb, bs, ...]:
+    client axis over 'clients', per-batch example axis over 'data'."""
+    has_data = "data" in mesh.axis_names
+    return P("clients", None, "data") if has_data else P("clients")
+
+
+def pad_federation(
+    packed: Batches, num_samples, multiple: int
+) -> Tuple[Batches, Any]:
+    """Pad the client axis up to a multiple with zero-sample dummy
+    clients (all-zero mask). Dummies are never sampled (sampling draws
+    indices < real client count) and contribute nothing to masked
+    metrics, so padding is semantically invisible."""
+    import jax.numpy as jnp
+
+    c = packed.mask.shape[0]
+    pad = (-c) % multiple
+    if pad == 0:
+        return packed, num_samples
+
+    def padleaf(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    return (
+        Batches(x=padleaf(packed.x), y=padleaf(packed.y), mask=padleaf(packed.mask)),
+        jnp.concatenate([jnp.asarray(num_samples), jnp.zeros(pad)]),
+    )
+
+
+def shard_federation(
+    packed: Batches, num_samples, mesh: Mesh
+) -> Tuple[Batches, jax.Array]:
+    """Place the packed federation on the mesh (client axis sharded)."""
+    spec = federation_spec(mesh)
+    sharding = NamedSharding(mesh, spec)
+    f = lambda a: jax.device_put(a, sharding)
+    import jax.numpy as jnp
+
+    ns = jax.device_put(jnp.asarray(num_samples), NamedSharding(mesh, P("clients")))
+    return Batches(x=f(packed.x), y=f(packed.y), mask=f(packed.mask)), ns
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a pytree (global params / opt state) across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def pad_cohort_to_mesh(cohort_size: int, mesh: Mesh) -> int:
+    """Cohort size must tile the 'clients' axis; callers pad sampling
+    up to the next multiple (weights of repeats are zeroed)."""
+    n = mesh.shape["clients"]
+    return -(-cohort_size // n) * n

@@ -1,0 +1,10 @@
+"""Simulation scenario ("Parrot" parity, SURVEY.md §2.9) — TPU-first.
+
+``Simulator*`` dispatchers mirror ``python/fedml/simulation/simulator.py``;
+the algorithm APIs live in ``fedavg_api.py`` (FedAvg / FedProx / FedOpt /
+FedNova share one jitted round engine) and ``hierarchical.py`` /
+``decentralized.py`` for the structured variants.
+"""
+
+from .fedavg_api import FedAvgAPI, FedOptAPI, FedProxAPI, FedNovaAPI  # noqa: F401
+from .simulator import SimulatorSingleProcess, SimulatorMesh  # noqa: F401

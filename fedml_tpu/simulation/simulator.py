@@ -1,0 +1,71 @@
+"""Simulator dispatchers (reference: ``simulation/simulator.py``).
+
+- ``SimulatorSingleProcess`` (simulator.py:28-40): one host, one chip;
+  vmap client batching.
+- ``SimulatorMesh``: the reference's stubbed ``SimulatorNCCL``
+  (simulator.py:100-108) done for real — the packed federation's client
+  axis is sharded over a ``jax.sharding.Mesh`` and aggregation rides ICI
+  collectives. Works identically on a TPU pod slice or on a virtual
+  multi-device CPU mesh (tests).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..parallel.mesh import build_mesh, pad_federation, replicate, shard_federation
+from .fedavg_api import ALGORITHMS
+
+
+def _select_algorithm(args):
+    name = getattr(args, "federated_optimizer", "FedAvg")
+    if name not in ALGORITHMS:
+        raise ValueError(
+            f"federated_optimizer {name!r} not supported; have {sorted(ALGORITHMS)}"
+        )
+    return ALGORITHMS[name]
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args, device, dataset, model) -> None:
+        cls = _select_algorithm(args)
+        self.fl_trainer = cls(args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorMesh:
+    """Client-parallel FL over a device mesh."""
+
+    def __init__(self, args, device, dataset, model, mesh=None) -> None:
+        self.mesh = mesh if mesh is not None else build_mesh(
+            mesh_shape=getattr(args, "mesh_shape", None)
+        )
+        n_client_shards = self.mesh.shape.get("clients", 1)
+        if int(args.client_num_per_round) % n_client_shards != 0:
+            raise ValueError(
+                f"client_num_per_round={args.client_num_per_round} must be a "
+                f"multiple of the mesh 'clients' axis ({n_client_shards})"
+            )
+        packed_train, ns_padded = pad_federation(
+            dataset.packed_train, dataset.packed_num_samples, n_client_shards
+        )
+        packed_test, _ = pad_federation(
+            dataset.packed_test, dataset.packed_num_samples, n_client_shards
+        )
+        dataset.packed_train, ns = shard_federation(
+            packed_train, ns_padded, self.mesh
+        )
+        dataset.packed_test, _ = shard_federation(
+            packed_test, ns_padded, self.mesh
+        )
+        dataset.packed_num_samples = ns_padded
+        cls = _select_algorithm(args)
+        self.fl_trainer = cls(args, device, dataset, model, mesh=self.mesh)
+        self.fl_trainer.global_params = replicate(
+            self.fl_trainer.global_params, self.mesh
+        )
+
+    def run(self):
+        return self.fl_trainer.train()

@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in this environment; per the
+reference's own pattern of running every scenario single-host
+(SURVEY.md §4 "multi-node without a cluster"), all sharding tests run on
+``--xla_force_host_platform_device_count=8`` CPU devices. The axon
+sitecustomize force-registers the TPU backend at interpreter start, so
+the override must go through jax.config, not just env vars.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+def make_args(**kw):
+    """Small helper to build Arguments without YAML."""
+    from fedml_tpu.arguments import Arguments
+
+    a = Arguments()
+    for k, v in kw.items():
+        setattr(a, k, v)
+    a._validate()
+    return a
+
+
+@pytest.fixture
+def args_factory():
+    return make_args

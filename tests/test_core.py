@@ -1,0 +1,175 @@
+"""Unit tests: partition, packing, aggregation, local trainer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.aggregation import (
+    RobustAggregator,
+    normalize_weights,
+    stack_pytrees,
+    weighted_average,
+)
+from fedml_tpu.core.local_trainer import make_eval_fn, make_local_train_fn
+from fedml_tpu.core.losses import softmax_cross_entropy
+from fedml_tpu.core.partition import (
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+)
+from fedml_tpu.data.packing import pack_clients, pack_one
+
+
+class TestPartition:
+    def test_lda_covers_all_samples(self):
+        y = np.random.RandomState(0).randint(0, 10, 1000)
+        m = non_iid_partition_with_dirichlet_distribution(y, 7, 10, 0.5, seed=1)
+        all_idx = np.concatenate([m[i] for i in range(7)])
+        assert sorted(all_idx.tolist()) == list(range(1000))
+
+    def test_lda_min_ten_samples(self):
+        # reference retry loop guarantees >=10 per client
+        y = np.random.RandomState(0).randint(0, 10, 1000)
+        m = non_iid_partition_with_dirichlet_distribution(y, 20, 10, 0.1, seed=2)
+        assert min(len(v) for v in m.values()) >= 10
+
+    def test_lda_skew_increases_as_alpha_drops(self):
+        y = np.random.RandomState(0).randint(0, 10, 5000)
+
+        def skew(alpha):
+            m = non_iid_partition_with_dirichlet_distribution(y, 10, 10, alpha, seed=3)
+            props = []
+            for i in range(10):
+                h = np.bincount(y[m[i]], minlength=10) / max(len(m[i]), 1)
+                props.append(h.max())
+            return np.mean(props)
+
+        assert skew(0.1) > skew(100.0)
+
+    def test_homo_equal_shards(self):
+        m = homo_partition(100, 4, seed=0)
+        assert all(len(m[i]) == 25 for i in range(4))
+
+
+class TestPacking:
+    def test_pack_one_masks_padding(self):
+        x = np.ones((7, 3), np.float32)
+        y = np.arange(7)
+        b = pack_one(x, y, batch_size=4)
+        assert b.x.shape == (2, 4, 3)
+        assert float(b.mask.sum()) == 7.0
+
+    def test_pack_clients_common_nb(self):
+        xs = [np.ones((5, 2), np.float32), np.ones((11, 2), np.float32)]
+        ys = [np.zeros(5, np.int64), np.zeros(11, np.int64)]
+        stacked, ns = pack_clients(xs, ys, batch_size=4)
+        assert stacked.x.shape == (2, 3, 4, 2)
+        assert ns.tolist() == [5.0, 11.0]
+        assert float(stacked.mask[0].sum()) == 5.0
+
+
+class TestAggregation:
+    def _trees(self):
+        t1 = {"w": jnp.ones((3, 2)), "b": jnp.zeros(2)}
+        t2 = {"w": 3 * jnp.ones((3, 2)), "b": 2 * jnp.ones(2)}
+        return stack_pytrees([t1, t2])
+
+    def test_weighted_average(self):
+        s = self._trees()
+        w = normalize_weights(jnp.array([1.0, 3.0]))
+        avg = weighted_average(s, w)
+        np.testing.assert_allclose(avg["w"], 2.5 * np.ones((3, 2)), atol=1e-6)
+        np.testing.assert_allclose(avg["b"], 1.5 * np.ones(2), atol=1e-6)
+
+    def test_clip_bounds_norms(self, args_factory):
+        args = args_factory(defense_type="norm_diff_clipping", norm_bound=0.5)
+        agg = RobustAggregator(args)
+        s = self._trees()
+        g = {"w": jnp.zeros((3, 2)), "b": jnp.zeros(2)}
+        clipped = agg.clip_updates(s, g)
+        for c in range(2):
+            delta = jax.tree.map(lambda l: l[c], clipped)
+            norm = jnp.sqrt(sum(jnp.vdot(x, x) for x in jax.tree.leaves(delta)))
+            assert float(norm) <= 0.5 + 1e-5
+
+    def test_median(self):
+        s = stack_pytrees(
+            [{"w": jnp.full((2,), v)} for v in (1.0, 100.0, 3.0)]
+        )
+        med = RobustAggregator.coordinate_median(s)
+        np.testing.assert_allclose(med["w"], [3.0, 3.0])
+
+
+class TestLocalTrainer:
+    def _setup(self):
+        from fedml_tpu.models.linear import LogisticRegression
+
+        mod = LogisticRegression(output_dim=4)
+        params = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+        apply_fn = lambda p, x: mod.apply({"params": p}, x)
+        return mod, params, apply_fn
+
+    def test_loss_decreases(self):
+        import optax
+
+        _, params, apply_fn = self._setup()
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(40, 8)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        b = pack_one(x, y, batch_size=10)
+        fn = make_local_train_fn(
+            apply_fn, softmax_cross_entropy, optax.sgd(0.5), epochs=5
+        )
+        new_params, metrics = jax.jit(fn)(params, b, jax.random.PRNGKey(1))
+        ev = make_eval_fn(apply_fn, softmax_cross_entropy)
+        before = ev(params, b)
+        after = ev(new_params, b)
+        assert float(after["loss_sum"]) < float(before["loss_sum"])
+
+    def test_padding_batches_are_noops(self):
+        """A fully-masked extra batch must not change the result, even
+        with a stateful optimizer (momentum)."""
+        import optax
+
+        _, params, apply_fn = self._setup()
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(20, 8)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        b_exact = pack_one(x, y, batch_size=10)  # 2 full batches
+        b_padded = pack_one(x, y, batch_size=10, num_batches=4)  # +2 empty
+        opt = optax.sgd(0.3, momentum=0.9)
+        fn = make_local_train_fn(
+            apply_fn, softmax_cross_entropy, opt, epochs=2, shuffle=False
+        )
+        p1, _ = jax.jit(fn)(params, b_exact, jax.random.PRNGKey(1))
+        p2, _ = jax.jit(fn)(params, b_padded, jax.random.PRNGKey(1))
+        jax.tree.map(
+            lambda a, c: np.testing.assert_allclose(a, c, atol=1e-6), p1, p2
+        )
+
+    def test_vmappable_over_clients(self):
+        import optax
+
+        _, params, apply_fn = self._setup()
+        rng = np.random.RandomState(0)
+        xs = [rng.normal(size=(12, 8)).astype(np.float32) for _ in range(3)]
+        ys = [(x[:, 0] > 0).astype(np.int64) for x in xs]
+        stacked, ns = pack_clients(xs, ys, batch_size=4)
+        fn = make_local_train_fn(
+            apply_fn, softmax_cross_entropy, optax.sgd(0.1), epochs=1, shuffle=False
+        )
+        rngs = jax.random.split(jax.random.PRNGKey(0), 3)
+        out, metrics = jax.jit(jax.vmap(fn, in_axes=(None, 0, 0)))(
+            params, stacked, rngs
+        )
+        # leading client axis on every leaf
+        for leaf in jax.tree.leaves(out):
+            assert leaf.shape[0] == 3
+        # vmap lane i == individual run i
+        from fedml_tpu.core.types import Batches
+
+        client0 = Batches(x=stacked.x[0], y=stacked.y[0], mask=stacked.mask[0])
+        p0, _ = jax.jit(fn)(params, client0, rngs[0])
+        jax.tree.map(
+            lambda a, c: np.testing.assert_allclose(a[0], c, atol=1e-5), out, p0
+        )

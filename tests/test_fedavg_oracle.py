@@ -1,0 +1,133 @@
+"""The reference CI's numeric-equivalence oracles, as proper tests.
+
+Oracle 1 (ci/CI-script-fedavg.sh:44-50): with full-batch clients, 1
+local epoch, all clients participating, plain SGD — FedAvg equals
+centralized full-batch gradient descent (weighted average of per-client
+full-batch steps == one global full-batch step). Asserted here both on
+parameters (atol 1e-5) and on train accuracy to 3 decimals, stronger
+than the reference's accuracy-only check.
+
+Oracle 2: vectorized (vmap) simulation == sequential simulation — the
+backend-independence property the reference gets from running the same
+algorithm under SP and MPI simulators (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.data import load
+from fedml_tpu.data.packing import pack_one
+from fedml_tpu.simulation import FedAvgAPI
+
+
+def _make_args(make, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=400,
+        synthetic_test_size=100,
+        model="lr",
+        partition_method="homo",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=3,
+        epochs=1,
+        batch_size=100,  # = client size -> full batch
+        learning_rate=0.1,
+        momentum=0.0,
+        weight_decay=0.0,
+        frequency_of_the_test=1,
+        shuffle=False,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+def _centralized_gd(model, params, x, y, lr, steps):
+    """Full-batch GD on the union dataset."""
+    b = pack_one(np.asarray(x), np.asarray(y), batch_size=len(x))
+
+    def loss(p):
+        logits = model.apply(p, b.x[0])
+        l, _ = model.loss_fn(logits, b.y[0], b.mask[0])
+        return l
+
+    grad = jax.jit(jax.grad(loss))
+    for _ in range(steps):
+        g = grad(params)
+        params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    return params
+
+
+class TestFederatedEqualsCentralized:
+    def test_params_match(self, args_factory):
+        args = _make_args(args_factory)
+        args = fedml_tpu.init(args)
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        api = FedAvgAPI(args, None, dataset, model)
+        init_params = jax.tree.map(jnp.array, api.global_params)  # donation-safe copy
+        api.train()
+
+        # centralized: same init, 3 full-batch GD steps on the union
+        from fedml_tpu.core.types import flat_examples
+
+        g = flat_examples(dataset.train_data_global)
+        keep = np.asarray(g.mask) > 0
+        x = np.asarray(g.x)[keep]
+        y = np.asarray(g.y)[keep]
+        central = _centralized_gd(
+            model, init_params, x, y, args.learning_rate, steps=args.comm_round
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            api.global_params,
+            central,
+        )
+
+    def test_train_accuracy_matches_3_decimals(self, args_factory):
+        args = _make_args(args_factory, comm_round=5)
+        args = fedml_tpu.init(args)
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        api = FedAvgAPI(args, None, dataset, model)
+        init_params = jax.tree.map(jnp.array, api.global_params)  # donation-safe copy
+        stats = api.train()
+
+        from fedml_tpu.core.types import flat_examples
+
+        g = flat_examples(dataset.train_data_global)
+        keep = np.asarray(g.mask) > 0
+        x, y = np.asarray(g.x)[keep], np.asarray(g.y)[keep]
+        central = _centralized_gd(model, init_params, x, y, args.learning_rate, 5)
+        logits = model.apply(central, jnp.asarray(x))
+        central_acc = float((jnp.argmax(logits, -1) == jnp.asarray(y)).mean())
+        assert round(stats["train_acc"], 3) == round(central_acc, 3)
+
+
+class TestBackendEquivalence:
+    def test_vectorized_equals_sequential(self, args_factory):
+        results = {}
+        for mode in ("vectorized", "sequential"):
+            args = _make_args(
+                args_factory,
+                partition_method="hetero",
+                batch_size=20,
+                comm_round=2,
+                epochs=2,
+            )
+            args.sim_mode = mode
+            args = fedml_tpu.init(args)
+            dataset = load(args)
+            model = models.create(args, dataset.class_num)
+            api = FedAvgAPI(args, None, dataset, model)
+            api.train()
+            results[mode] = api.global_params
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            results["vectorized"],
+            results["sequential"],
+        )
