@@ -155,6 +155,14 @@ class Arguments:
             raise ValueError(f"unknown training_type {t!r}; expected one of {sorted(valid)}")
         if self.client_num_per_round > self.client_num_in_total:
             self.client_num_per_round = self.client_num_in_total
+        if (
+            t == constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+            and self.backend
+            in (constants.COMM_BACKEND_SP, constants.FEDML_SIMULATION_TYPE_SP)
+        ):
+            # the simulation default backend makes no sense cross-silo;
+            # LOCAL runs single-host worlds, GRPC is the networked path
+            self.backend = constants.COMM_BACKEND_LOCAL
         for int_key in (
             "client_num_in_total",
             "client_num_per_round",

@@ -1,0 +1,4 @@
+"""L1 communication backends (reference inventory: SURVEY.md §2.2)."""
+
+from .base import BaseCommunicationManager, Observer  # noqa: F401
+from .local import LocalCommunicationManager  # noqa: F401

@@ -1,0 +1,43 @@
+"""Abstract communication manager + observer.
+
+Parity with ``python/fedml/core/distributed/communication/
+base_com_manager.py:7-26`` and ``observer.py:4-7``: the contract that
+keeps every algorithm transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict
+
+from ..message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: int, msg_params: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def add_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def remove_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block, delivering inbound messages to observers, until
+        ``stop_receive_message`` is called."""
+        ...
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...

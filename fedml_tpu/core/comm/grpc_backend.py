@@ -1,0 +1,137 @@
+"""gRPC transport (DCN) — cross-silo's networked backend.
+
+Parity with ``python/fedml/core/distributed/communication/grpc/
+grpc_comm_manager.py``: every node runs a gRPC server on
+``port_base + rank`` (reference: ``8888 + rank``, grpc_comm_manager.py:72-75),
+send = one unary RPC carrying the serialized Message, receiver enqueues
+and a dispatch loop notifies observers (grpc_server.py:36-39 /
+grpc_comm_manager.py:101-113). Static IP table maps ranks to hosts
+(``ip_config_utils.py`` CSV).
+
+Differences by design: (a) no generated protobuf stubs — the wire
+format is the Message's msgpack blob over a generic bytes/bytes unary
+method, so there is no protoc step and no pickle (the reference pickles,
+grpc_comm_manager.py:67-87); (b) the dispatch loop blocks on a queue
+instead of busy-wait polling.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from ..message import Message
+from .base import BaseCommunicationManager, Observer
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+_MAX_MSG = 1000 * 1024 * 1024  # 1000 MB, matching grpc_comm_manager.py:41-45
+_STOP = object()
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+class GrpcCommunicationManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        ip_config: Optional[Dict[int, str]] = None,
+        port_base: int = 8890,
+        host: str = "0.0.0.0",
+    ) -> None:
+        self.rank = int(rank)
+        self.size = int(size)
+        self.port_base = int(port_base)
+        self.ip_config = ip_config or {r: "127.0.0.1" for r in range(size)}
+        self._observers: List[Observer] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._running = False
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._stubs: Dict[int, object] = {}
+        self._lock = threading.Lock()
+
+        opts = [
+            ("grpc.max_send_message_length", _MAX_MSG),
+            ("grpc.max_receive_message_length", _MAX_MSG),
+        ]
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8), options=opts
+        )
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _METHOD: grpc.unary_unary_rpc_method_handler(
+                    self._on_rpc,
+                    request_deserializer=_ident,
+                    response_serializer=_ident,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self.port_base + self.rank
+        bound = self._server.add_insecure_port(f"{host}:{self.port}")
+        if bound == 0:
+            raise RuntimeError(f"could not bind gRPC port {self.port}")
+        self._server.start()
+        logging.info("grpc comm manager rank %d listening on %d", rank, self.port)
+
+    # -- server side ---------------------------------------------------
+    def _on_rpc(self, request: bytes, context) -> bytes:
+        self._q.put(Message.from_bytes(request))
+        return b"ok"
+
+    # -- client side ---------------------------------------------------
+    def _stub(self, rank: int):
+        with self._lock:
+            if rank not in self._stubs:
+                addr = f"{self.ip_config[rank]}:{self.port_base + rank}"
+                channel = grpc.insecure_channel(
+                    addr,
+                    options=[
+                        ("grpc.max_send_message_length", _MAX_MSG),
+                        ("grpc.max_receive_message_length", _MAX_MSG),
+                    ],
+                )
+                self._channels[rank] = channel
+                self._stubs[rank] = channel.unary_unary(
+                    f"/{_SERVICE}/{_METHOD}",
+                    request_serializer=_ident,
+                    response_deserializer=_ident,
+                )
+            return self._stubs[rank]
+
+    def send_message(self, msg: Message) -> None:
+        receiver = int(msg.get_receiver_id())
+        self._stub(receiver)(msg.to_bytes(), wait_for_ready=True, timeout=300)
+
+    # -- observer loop -------------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(item.get_type(), item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._q.put(_STOP)
+        for ch in self._channels.values():
+            ch.close()
+        self._server.stop(grace=1.0)

@@ -1,0 +1,97 @@
+"""In-process transport: per-rank queues inside one Python process.
+
+The TPU-native stand-in for the reference's MPI backend
+(``mpi/com_manager.py``): where the reference runs N+1 OS processes
+under ``mpirun`` and pickles messages between them
+(``mpi_send_thread.py:27``), single-host multi-actor runs here are
+threads sharing one JAX runtime — messages are enqueued directly (zero
+serialization; device arrays pass by reference, the seam the
+reference's ``enable_cuda_rpc`` only approximates). Event-driven via
+``queue.Queue`` blocking gets — no 0.3 s poll loop
+(cf. ``com_manager.py:77-84``).
+
+Also the test "fake backend" SURVEY.md §4 calls for: every scenario can
+run single-host with this transport and must produce identical numbers
+to the networked ones.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import defaultdict
+from typing import Dict, List
+
+from ..message import Message
+from .base import BaseCommunicationManager, Observer
+
+_STOP = object()
+
+
+class _Fabric:
+    """A named in-process fabric: one inbox per rank."""
+
+    _fabrics: Dict[str, "_Fabric"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.inboxes: Dict[int, "queue.Queue"] = defaultdict(queue.Queue)
+
+    @classmethod
+    def get(cls, name: str) -> "_Fabric":
+        with cls._lock:
+            if name not in cls._fabrics:
+                cls._fabrics[name] = _Fabric()
+            return cls._fabrics[name]
+
+    @classmethod
+    def destroy(cls, name: str) -> None:
+        with cls._lock:
+            cls._fabrics.pop(name, None)
+
+
+class LocalCommunicationManager(BaseCommunicationManager):
+    def __init__(self, fabric_name: str, rank: int, size: int) -> None:
+        self.fabric = _Fabric.get(fabric_name)
+        self.fabric_name = fabric_name
+        self.rank = int(rank)
+        self.size = int(size)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        receiver = int(msg.get_receiver_id())
+        self.fabric.inboxes[receiver].put(msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        inbox = self.fabric.inboxes[self.rank]
+        while self._running:
+            item = inbox.get()
+            if item is _STOP:
+                break
+            for obs in list(self._observers):
+                try:
+                    obs.receive_message(item.get_type(), item)
+                except Exception:
+                    logging.exception("observer failed on %s", item)
+                    raise
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.fabric.inboxes[self.rank].put(_STOP)
+
+    def destroy_fabric(self) -> None:
+        """Drop the fabric from the process-global registry so a later
+        run reusing this run_id starts with fresh inboxes. Existing
+        managers keep their direct queue references, so this is safe to
+        call from the rank that finishes first (the server)."""
+        _Fabric.destroy(self.fabric_name)

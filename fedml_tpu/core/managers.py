@@ -1,0 +1,122 @@
+"""L2 distributed managers: backend dispatch + handler registry + run loop.
+
+Parity with ``python/fedml/core/distributed/client/client_manager.py:20-148``
+and ``server/server_manager.py:19-143``: constructor is a backend
+dispatch table, ``run()`` registers handlers then blocks in
+``com_manager.handle_receive_message()``, handlers keyed by message
+type via ``register_message_receive_handler``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+from .. import constants
+from .comm.base import BaseCommunicationManager, Observer
+from .comm.local import LocalCommunicationManager
+from .message import Message
+
+
+def _build_com_manager(
+    args, rank: int, size: int, backend: str
+) -> BaseCommunicationManager:
+    """Backend dispatch (client_manager.py:27-94)."""
+    backend = (backend or constants.COMM_BACKEND_LOCAL).upper()
+    if backend in (constants.COMM_BACKEND_LOCAL, constants.COMM_BACKEND_MPI):
+        fabric = f"run_{getattr(args, 'run_id', '0')}"
+        return LocalCommunicationManager(fabric, rank, size)
+    if backend == constants.COMM_BACKEND_GRPC:
+        from .comm.grpc_backend import GrpcCommunicationManager
+
+        ip_config = None
+        path = getattr(args, "grpc_ipconfig_path", None)
+        if path:
+            ip_config = _load_ip_config(path)
+        return GrpcCommunicationManager(
+            rank=rank,
+            size=size,
+            ip_config=ip_config,
+            port_base=int(getattr(args, "grpc_port_base", 8890)),
+        )
+    raise ValueError(f"unsupported comm backend {backend!r}")
+
+
+def _load_ip_config(path: str) -> Dict[int, str]:
+    """CSV rank,ip table (reference ip_config_utils.py)."""
+    table: Dict[int, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("receiver_id"):
+                continue
+            rank_s, ip = line.split(",")[:2]
+            table[int(rank_s)] = ip.strip()
+    return table
+
+
+class _ManagerBase(Observer):
+    def __init__(
+        self,
+        args,
+        comm: Optional[BaseCommunicationManager] = None,
+        rank: int = 0,
+        size: int = 0,
+        backend: str = constants.COMM_BACKEND_LOCAL,
+    ) -> None:
+        self.args = args
+        self.rank = int(rank)
+        self.size = int(size)
+        self.backend = backend
+        self.com_manager = comm if comm is not None else _build_com_manager(
+            args, rank, size, backend
+        )
+        self.com_manager.add_observer(self)
+        self.message_handler_dict: Dict[int, Callable[[Message], None]] = {}
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.on_ready()
+        self.com_manager.handle_receive_message()
+        logging.info("rank %d manager loop exited", self.rank)
+
+    def on_ready(self) -> None:
+        """Called once before the receive loop; transports with no
+        connection phase use it to synthesize CONNECTION_IS_READY
+        (the reference's MQTT on_connect analog)."""
+        handler = self.message_handler_dict.get(constants.MSG_TYPE_CONNECTION_IS_READY)
+        if handler is not None:
+            msg = Message(constants.MSG_TYPE_CONNECTION_IS_READY, self.rank, self.rank)
+            handler(msg)
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their handlers here."""
+
+    def register_message_receive_handler(
+        self, msg_type: int, handler: Callable[[Message], None]
+    ) -> None:
+        self.message_handler_dict[int(msg_type)] = handler
+
+    def receive_message(self, msg_type: int, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(int(msg_type))
+        if handler is None:
+            logging.warning(
+                "rank %d: no handler for msg_type %s", self.rank, msg_type
+            )
+            return
+        handler(msg_params)
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def finish(self) -> None:
+        """Teardown (client_manager.py:135-148)."""
+        self.com_manager.stop_receive_message()
+
+
+class ClientManager(_ManagerBase):
+    """(client_manager.py:20-148)"""
+
+
+class ServerManager(_ManagerBase):
+    """(server_manager.py:19-143)"""

@@ -1,0 +1,84 @@
+"""Transport-agnostic message envelope.
+
+Parity with ``python/fedml/core/distributed/communication/message.py:5-80``:
+a dict envelope carrying ``msg_type`` / ``sender`` / ``receiver`` plus
+arbitrary params; ``MSG_ARG_KEY_MODEL_PARAMS`` carries the model payload.
+
+Improvement over the reference (which pickles torch state_dicts —
+``mpi_send_thread.py:27`` — or JSON-encodes, ``message.py:68-71``):
+serialization is msgpack via ``flax.serialization`` with numpy leaves,
+so a payload is one contiguous bytes blob, language-neutral, and free of
+pickle's code-execution hazard. Device arrays are converted at the
+transport boundary only (SURVEY.md §7 "hard parts": no
+double-serialization seam).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from flax import serialization
+
+from .. import constants
+
+
+class Message:
+    MSG_ARG_KEY_TYPE = constants.MSG_ARG_KEY_TYPE
+    MSG_ARG_KEY_SENDER = constants.MSG_ARG_KEY_SENDER
+    MSG_ARG_KEY_RECEIVER = constants.MSG_ARG_KEY_RECEIVER
+    MSG_ARG_KEY_MODEL_PARAMS = constants.MSG_ARG_KEY_MODEL_PARAMS
+    MSG_ARG_KEY_NUM_SAMPLES = constants.MSG_ARG_KEY_NUM_SAMPLES
+    MSG_ARG_KEY_CLIENT_INDEX = constants.MSG_ARG_KEY_CLIENT_INDEX
+    MSG_ARG_KEY_CLIENT_STATUS = constants.MSG_ARG_KEY_CLIENT_STATUS
+    MSG_ARG_KEY_ROUND_INDEX = constants.MSG_ARG_KEY_ROUND_INDEX
+
+    def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
+        self.msg_params: Dict[str, Any] = {
+            self.MSG_ARG_KEY_TYPE: int(msg_type),
+            self.MSG_ARG_KEY_SENDER: int(sender_id),
+            self.MSG_ARG_KEY_RECEIVER: int(receiver_id),
+        }
+
+    # -- accessors (message.py:24-66 parity) --------------------------
+    def get_sender_id(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_SENDER]
+
+    def get_receiver_id(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_RECEIVER]
+
+    def get_type(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_TYPE]
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def add(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    # -- wire format ---------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """msgpack-encode; jax.Array leaves become numpy arrays."""
+        host = jax.tree.map(
+            lambda v: np.asarray(v) if isinstance(v, jax.Array) else v,
+            self.msg_params,
+        )
+        return serialization.msgpack_serialize(host)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Message":
+        params = serialization.msgpack_restore(data)
+        m = cls()
+        m.msg_params = params
+        return m
+
+    def __repr__(self) -> str:  # pragma: no cover
+        keys = {k: type(v).__name__ for k, v in self.msg_params.items()}
+        return f"Message(type={self.get_type()}, {keys})"

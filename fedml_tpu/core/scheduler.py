@@ -1,0 +1,88 @@
+"""Heterogeneity-aware workload scheduler.
+
+Parity with ``python/fedml/core/schedule/scheduler.py`` (183 LoC):
+assign heterogeneous client workloads to resources under per-resource
+memory constraints, minimizing makespan — the "Parrot" scheduling seed
+(SURVEY.md §2.6). ``DP_schedule(mode)`` produces per-resource job
+"bunches" (scheduler.py:110-172).
+
+In this framework the scheduler has a real consumer the reference never
+wired up: balancing simulated clients across mesh shards. With padded
+client batching, each device trains max(nb_i) batches — packing clients
+so per-shard total work is even is exactly this makespan problem
+(``balance_clients_across_shards``, used by the mesh simulator's
+bucketing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def greedy_makespan(
+    workloads: Sequence[float], num_resources: int
+) -> Tuple[List[List[int]], float]:
+    """LPT greedy: sort jobs descending, put each on the least-loaded
+    resource (the reference's 'serial' DP mode approximation,
+    scheduler.py:14-60). Returns (job ids per resource, makespan)."""
+    order = np.argsort(-np.asarray(workloads, dtype=np.float64))
+    loads = np.zeros(num_resources)
+    assign: List[List[int]] = [[] for _ in range(num_resources)]
+    for j in order:
+        r = int(np.argmin(loads))
+        assign[r].append(int(j))
+        loads[r] += workloads[j]
+    return assign, float(loads.max())
+
+
+def dp_schedule(
+    workloads: Sequence[float],
+    constraints: Sequence[float],
+    memory: Sequence[float],
+    mode: int = 0,
+) -> List[List[int]]:
+    """``DP_schedule`` parity (scheduler.py:110-172): jobs with memory
+    footprints onto resources with memory caps; mode 0 = serial
+    (one bunch per resource, minimize makespan), mode 1 = parallel
+    (fill respecting memory, then balance runtime)."""
+    n_res = len(constraints)
+    order = np.argsort(-np.asarray(workloads, dtype=np.float64))
+    loads = np.zeros(n_res)
+    mem_used = np.zeros(n_res)
+    assign: List[List[int]] = [[] for _ in range(n_res)]
+    for j in order:
+        # feasible resources by memory constraint
+        feasible = [r for r in range(n_res) if mem_used[r] + memory[j] <= constraints[r]]
+        if not feasible:
+            feasible = list(range(n_res))  # overflow: least loaded anyway
+        r = min(feasible, key=lambda r_: loads[r_])
+        assign[r].append(int(j))
+        loads[r] += workloads[j]
+        mem_used[r] += memory[j]
+    if mode == 1:
+        # parallel mode: round-robin within each resource's bunch to
+        # interleave large/small jobs (scheduler.py parallel branch)
+        assign = [sorted(b, key=lambda j_: -workloads[j_]) for b in assign]
+    return assign
+
+
+def balance_clients_across_shards(
+    client_sizes: Sequence[int], num_shards: int
+) -> List[List[int]]:
+    """Equal-count, near-equal-load shard assignment: sort clients by
+    size descending and deal them boustrophedon (snake) across shards
+    (0..S-1, S-1..0, ...). Each shard gets exactly ceil(C/S) clients
+    (trailing shards one fewer when C % S != 0) with balanced total
+    samples — the mesh-simulator consumer of the makespan idea."""
+    order = np.argsort(-np.asarray(client_sizes, dtype=np.float64))
+    shards: List[List[int]] = [[] for _ in range(num_shards)]
+    forward = True
+    for start in range(0, len(order), num_shards):
+        block = order[start : start + num_shards]
+        targets = range(len(block)) if forward else range(len(block) - 1, -1, -1)
+        for j, t in zip(block, targets):
+            shards[t].append(int(j))
+        forward = not forward
+    return shards

@@ -1,0 +1,183 @@
+"""Observability: event spans, metrics reporting, run logging.
+
+Parity with the reference's MLOps subsystem (SURVEY.md §5) behind
+interfaces with no platform dependency:
+
+- ``ProfilerEvent`` ~ ``MLOpsProfilerEvent``
+  (core/mlops/mlops_profiler_event.py:11-100): STARTED/ENDED spans
+  around ``train`` / ``comm`` / ``server.wait`` / ``aggregate``; here
+  spans also record device wall time and are queryable in-process
+  (the reference fires JSON into MQTT and forgets).
+- ``MetricsReporter`` ~ ``MLOpsMetrics`` (mlops_metrics.py:15-120):
+  round/train/test metrics to pluggable sinks (logging, JSONL file,
+  user callback) instead of fixed MQTT topics.
+- ``RunLogger`` ~ ``MLOpsRuntimeLog`` (mlops_runtime_log.py:12-221):
+  per-run log files with the chunked-upload seam kept as an interface
+  (the reference uploads 100-line chunks to open.fedml.ai).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+EVENT_TYPE_STARTED = 0  # mlops_profiler_event.py:12
+EVENT_TYPE_ENDED = 1  # mlops_profiler_event.py:13
+
+
+class ProfilerEvent:
+    """Span recorder. ``log_event_started(name)`` /
+    ``log_event_ended(name)`` mirror the reference API."""
+
+    _instance: Optional["ProfilerEvent"] = None
+
+    def __init__(self, args=None) -> None:
+        self.args = args
+        self.run_id = getattr(args, "run_id", "0") if args else "0"
+        self._open: Dict[str, float] = {}
+        self.spans: List[Dict[str, Any]] = []
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @classmethod
+    def get_instance(cls, args=None) -> "ProfilerEvent":
+        if cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    def log_event_started(self, event_name: str, value: Any = None) -> None:
+        self._open[event_name] = time.perf_counter()
+
+    def log_event_ended(self, event_name: str, value: Any = None) -> None:
+        t0 = self._open.pop(event_name, None)
+        if t0 is None:
+            logging.warning("span %r ended without start", event_name)
+            return
+        dt = time.perf_counter() - t0
+        self.spans.append(
+            {"name": event_name, "duration_s": dt, "ended_at": time.time()}
+        )
+        self.totals[event_name] += dt
+        self.counts[event_name] += 1
+
+    def span(self, name: str):
+        """Context-manager sugar the reference lacks."""
+        return _Span(self, name)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"total_s": self.totals[k], "count": self.counts[k]}
+            for k in self.totals
+        }
+
+
+class _Span:
+    def __init__(self, ev: ProfilerEvent, name: str) -> None:
+        self.ev, self.name = ev, name
+
+    def __enter__(self):
+        self.ev.log_event_started(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        self.ev.log_event_ended(self.name)
+        return False
+
+
+Sink = Callable[[Dict[str, Any]], None]
+
+
+class MetricsReporter:
+    """Round/train/test metrics to pluggable sinks."""
+
+    def __init__(self, args=None, keep_history: bool = True) -> None:
+        self.sinks: List[Sink] = []
+        self.keep_history = keep_history
+        self.history: List[Dict[str, Any]] = []
+        path = getattr(args, "metrics_jsonl_path", None) if args else None
+        if path:
+            self.add_jsonl_sink(path)
+        if args is None or getattr(args, "log_metrics", True):
+            self.sinks.append(lambda rec: logging.info("metrics: %s", rec))
+
+    def add_sink(self, sink: Sink) -> None:
+        self.sinks.append(sink)
+
+    def add_jsonl_sink(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+        def write(rec: Dict[str, Any]) -> None:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+        self.sinks.append(write)
+
+    def report(self, record: Dict[str, Any]) -> None:
+        rec = {"ts": time.time(), **record}
+        if self.keep_history:
+            self.history.append(rec)
+        for s in self.sinks:
+            try:
+                s(rec)
+            except Exception:
+                logging.exception("metrics sink failed")
+
+    # reference-API aliases (mlops_metrics.py)
+    def report_server_training_metric(self, metric: Dict[str, Any]) -> None:
+        self.report({"kind": "server_train", **metric})
+
+    def report_client_training_metric(self, metric: Dict[str, Any]) -> None:
+        self.report({"kind": "client_train", **metric})
+
+
+class RunLogger:
+    """Per-run file logging with an upload seam."""
+
+    _instance: Optional["RunLogger"] = None
+    CHUNK_LINES = 100  # mlops_runtime_log.py:13
+
+    def __init__(self, args=None) -> None:
+        self.args = args
+        self.uploader: Optional[Callable[[List[str]], None]] = None
+        self._pending: List[str] = []
+
+    @classmethod
+    def get_instance(cls, args=None) -> "RunLogger":
+        if cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    def init_logs(self, log_dir: Optional[str] = None) -> None:
+        run_id = getattr(self.args, "run_id", "0") if self.args else "0"
+        rank = getattr(self.args, "rank", 0) if self.args else 0
+        handlers: List[logging.Handler] = [logging.StreamHandler()]
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(log_dir, f"run_{run_id}_rank_{rank}.log")
+            handlers.append(logging.FileHandler(path))
+        logging.basicConfig(
+            level=logging.INFO,
+            format="[%(asctime)s %(levelname)s rank" + str(rank) + "] %(message)s",
+            handlers=handlers,
+            force=True,
+        )
+
+    def set_uploader(self, fn: Callable[[List[str]], None]) -> None:
+        """Chunked-upload seam (mlops_runtime_log.py:41-47)."""
+        self.uploader = fn
+
+    def upload_line(self, line: str) -> None:
+        if self.uploader is None:
+            return
+        self._pending.append(line)
+        if len(self._pending) >= self.CHUNK_LINES:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.uploader and self._pending:
+            self.uploader(list(self._pending))
+            self._pending.clear()

@@ -1,27 +1,64 @@
 """Cross-silo scenario ("Octopus" parity, SURVEY.md §2.10).
 
-The message-layer milestone lands the real ``Client`` / ``Server``
-(gRPC + in-process transports, presence handshake, client-id
-indirection). Until then the one-line entry points fail with a clear
-error instead of an ImportError.
+Facades mirroring ``python/fedml/cross_silo/client.py:4-22`` /
+``server.py``: a ``Server`` (rank 0) and N silo ``Client``s (ranks
+1..N) speaking the 3-message FedAvg protocol over a pluggable transport
+— in-process queues (single host / tests) or gRPC (DCN). The presence
+handshake, silo-index indirection, and round loop live in
+``horizontal/``.
 """
 
 from __future__ import annotations
 
+from .. import constants
+from .horizontal.fedml_aggregator import FedMLAggregator
+from .horizontal.fedml_client_manager import FedMLClientManager, FedMLTrainer
+from .horizontal.fedml_server_manager import FedMLServerManager
 
-class _NotYet:
-    _msg = (
-        "cross-silo is not available yet in this build; "
-        "use fedml_tpu.run_simulation() (simulation scenario)"
-    )
-
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(self._msg)
+__all__ = ["Client", "Server"]
 
 
-class Client(_NotYet):
-    pass
+def _world_size(args) -> int:
+    return int(args.client_num_per_round) + 1
 
 
-class Server(_NotYet):
-    pass
+class Server:
+    def __init__(self, args, device, dataset, model) -> None:
+        self.args = args
+        aggregator = FedMLAggregator(
+            args, model, test_data=dataset.test_data_global if dataset else None
+        )
+        self.aggregator = aggregator
+        self.manager = FedMLServerManager(
+            args,
+            aggregator,
+            rank=0,
+            size=_world_size(args),
+            backend=getattr(args, "backend", constants.COMM_BACKEND_LOCAL),
+        )
+
+    def run(self) -> None:
+        self.manager.run()
+        com = self.manager.com_manager
+        if hasattr(com, "destroy_fabric"):
+            com.destroy_fabric()
+
+
+class Client:
+    def __init__(self, args, device, dataset, model) -> None:
+        self.args = args
+        rank = int(getattr(args, "rank", 1))
+        if rank < 1:
+            raise ValueError("cross-silo client rank must be >= 1 (0 is the server)")
+        trainer = FedMLTrainer(args, dataset, model)
+        self.trainer = trainer
+        self.manager = FedMLClientManager(
+            args,
+            trainer,
+            rank=rank,
+            size=_world_size(args),
+            backend=getattr(args, "backend", constants.COMM_BACKEND_LOCAL),
+        )
+
+    def run(self) -> None:
+        self.manager.run()

@@ -1,0 +1,114 @@
+"""Server-side aggregation state for cross-silo rounds.
+
+Parity with ``python/fedml/cross_silo/horizontal/fedml_aggregator.py:15-153``:
+collect per-client results, check-all-received, weighted aggregate, the
+``data_silo_selection`` / ``client_selection`` split that lets N real
+edge devices map onto M data silos, and deterministic per-round
+sampling. Aggregation itself is the on-device pytree reduction from
+``core.aggregation`` (the reference loops over python dicts on host).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.aggregation import (
+    normalize_weights,
+    stack_pytrees,
+    weighted_average,
+)
+from ...core.local_trainer import make_eval_fn
+
+Params = Any
+
+
+class FedMLAggregator:
+    def __init__(self, args, model, test_data=None) -> None:
+        self.args = args
+        self.model = model
+        self.test_data = test_data
+        self.client_num = int(args.client_num_per_round)
+        self.model_dict: Dict[int, Params] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
+        # same init-rng convention as the simulators (FedAvgAPI.__init__)
+        # so cross-silo and simulation runs are bit-comparable
+        _, init_rng = jax.random.split(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        )
+        self.global_params: Params = model.init(init_rng)
+        self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+
+    def get_global_model_params(self) -> Params:
+        return self.global_params
+
+    def set_global_model_params(self, params: Params) -> None:
+        self.global_params = params
+
+    def add_local_trained_result(
+        self, index: int, model_params: Params, sample_num: float
+    ) -> None:
+        """(fedml_aggregator.py:58-63)"""
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        """(fedml_aggregator.py:65-71)"""
+        if len(self.flag_client_model_uploaded_dict) < self.client_num:
+            return False
+        for idx in range(self.client_num):
+            if not self.flag_client_model_uploaded_dict.get(idx, False):
+                return False
+        for idx in range(self.client_num):
+            self.flag_client_model_uploaded_dict[idx] = False
+        return True
+
+    def aggregate(self) -> Params:
+        """Weighted average of the received models
+        (fedml_aggregator.py:73-101)."""
+        trees = [self.model_dict[i] for i in range(self.client_num)]
+        ns = jnp.asarray([self.sample_num_dict[i] for i in range(self.client_num)])
+        stacked = stack_pytrees(trees)
+        self.global_params = weighted_average(stacked, normalize_weights(ns))
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        return self.global_params
+
+    # -- selection (fedml_aggregator.py:103-153) ----------------------
+    def data_silo_selection(
+        self, round_idx: int, data_silo_num_in_total: int, client_num_in_total: int
+    ) -> List[int]:
+        """Pick which data silos train this round: one silo index per
+        participating client."""
+        if data_silo_num_in_total == client_num_in_total:
+            return list(range(data_silo_num_in_total))
+        np.random.seed(round_idx)
+        return np.random.choice(
+            range(data_silo_num_in_total), client_num_in_total, replace=False
+        ).tolist()
+
+    def client_selection(
+        self, round_idx: int, client_id_list_in_total: List, client_num_per_round: int
+    ) -> List:
+        """Pick which REAL clients participate (client-id indirection,
+        fedml_server_manager.py:33)."""
+        if client_num_per_round >= len(client_id_list_in_total):
+            return list(client_id_list_in_total)
+        np.random.seed(round_idx)
+        return np.random.choice(
+            client_id_list_in_total, client_num_per_round, replace=False
+        ).tolist()
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict]:
+        if self.test_data is None:
+            return None
+        sums = self._eval(self.global_params, self.test_data)
+        stats = self.model.metrics_from_sums(sums)
+        logging.info("server eval round %d: %s", round_idx, stats)
+        return stats

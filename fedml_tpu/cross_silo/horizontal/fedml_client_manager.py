@@ -1,0 +1,122 @@
+"""Cross-silo client manager + trainer wrapper.
+
+Parity with ``python/fedml/cross_silo/horizontal/fedml_client_manager.py:14-171``
+and ``fedml_trainer.py:4-60``: on CONNECTION_IS_READY announce ONLINE;
+on init/sync set global params, train the assigned silo, send the
+result. Training is the jitted functional local trainer — params stay
+on device between receive and send when the transport is in-process.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from ... import constants
+from ...core.local_trainer import make_local_train_fn
+from ...core.managers import ClientManager
+from ...core.message import Message
+from ...core.optimizers import create_client_optimizer
+from ...core.types import Batches
+
+
+class FedMLTrainer:
+    """(fedml_trainer.py:4-60): holds the local data dict and the
+    jitted update; ``update_dataset(index)`` switches silo."""
+
+    def __init__(self, args, dataset, model) -> None:
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.client_index: Optional[int] = None
+        self._fn = jax.jit(
+            make_local_train_fn(
+                model.apply,
+                model.loss_fn,
+                create_client_optimizer(args),
+                epochs=int(args.epochs),
+                prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
+                shuffle=bool(getattr(args, "shuffle", True)),
+            )
+        )
+
+    def update_dataset(self, client_index: int) -> None:
+        self.client_index = int(client_index)
+
+    def train(self, params, round_idx: int):
+        i = self.client_index
+        packed = self.dataset.packed_train
+        client = Batches(x=packed.x[i], y=packed.y[i], mask=packed.mask[i])
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
+            round_idx * 100003 + i,
+        )
+        new_params, metrics = self._fn(params, client, rng)
+        n = float(self.dataset.packed_num_samples[i])
+        return new_params, n
+
+
+class FedMLClientManager(ClientManager):
+    def __init__(
+        self,
+        args,
+        trainer: FedMLTrainer,
+        comm=None,
+        rank=0,
+        size=0,
+        backend=constants.COMM_BACKEND_LOCAL,
+    ) -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer
+        self.server_rank = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self.handle_message_receive_model_from_server,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_FINISH, self.handle_message_finish
+        )
+
+    # -- handlers (fedml_client_manager.py:49-130) --------------------
+    def handle_connection_ready(self, msg: Message) -> None:
+        self.send_client_status(self.server_rank)
+
+    def send_client_status(self, receiver_id: int) -> None:
+        msg = Message(constants.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, receiver_id)
+        msg.add_params(
+            constants.MSG_ARG_KEY_CLIENT_STATUS, constants.CLIENT_STATUS_ONLINE
+        )
+        self.send_message(msg)
+
+    def handle_message_init(self, msg: Message) -> None:
+        self._train_and_send(msg)
+
+    def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        self._train_and_send(msg)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        logging.info("client rank %d: finish", self.rank)
+        self.finish()
+
+    def _train_and_send(self, msg: Message) -> None:
+        params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
+        round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
+        self.trainer.update_dataset(client_index)
+        new_params, n = self.trainer.train(params, round_idx)
+        out = Message(
+            constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, self.server_rank
+        )
+        out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)
+        out.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, n)
+        self.send_message(out)

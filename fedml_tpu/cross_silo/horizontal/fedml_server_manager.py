@@ -1,0 +1,119 @@
+"""Cross-silo server manager: presence handshake + round loop.
+
+Parity with ``python/fedml/cross_silo/horizontal/fedml_server_manager.py:11-235``:
+
+- clients announce ONLINE (``MSG_TYPE_C2S_CLIENT_STATUS``); the server
+  waits for ALL before ``send_init_msg`` (:95-119) — the handshake the
+  simulation scenario doesn't need;
+- round loop: on every client model received -> aggregate -> silo/client
+  selection -> sync (:121-207);
+- client-id indirection: messages go to ranks 1..N, training assignments
+  are silo indices (``data_silo_selection``).
+
+The terminal round sends ``MSG_TYPE_S2C_FINISH`` so clients exit their
+receive loops cleanly (the reference relies on ``finish()`` +
+sys.exit, fedml_server_manager.py:209-213).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from ... import constants
+from ...core.managers import ServerManager
+from ...core.message import Message
+
+
+class FedMLServerManager(ServerManager):
+    def __init__(
+        self,
+        args,
+        aggregator,
+        comm=None,
+        rank=0,
+        size=0,
+        backend=constants.COMM_BACKEND_LOCAL,
+    ) -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.round_idx = 0
+        self.client_online_status: Dict[int, bool] = {}
+        self.client_real_ids = list(range(1, size))  # ranks of clients
+        self.is_initialized = False
+
+    # -- handlers ------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_CLIENT_STATUS,
+            self.handle_message_client_status_update,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client,
+        )
+
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        """(fedml_server_manager.py:95-119)"""
+        status = msg.get(constants.MSG_ARG_KEY_CLIENT_STATUS)
+        if status == constants.CLIENT_STATUS_ONLINE:
+            self.client_online_status[msg.get_sender_id()] = True
+        all_online = all(
+            self.client_online_status.get(r, False) for r in self.client_real_ids
+        )
+        if all_online and not self.is_initialized:
+            self.is_initialized = True
+            self.send_init_msg()
+
+    def send_init_msg(self) -> None:
+        """(fedml_server_manager.py:47-69)"""
+        silo_indexes = self.aggregator.data_silo_selection(
+            self.round_idx,
+            int(self.args.client_num_in_total),
+            len(self.client_real_ids),
+        )
+        global_params = self.aggregator.get_global_model_params()
+        for rank, silo_idx in zip(self.client_real_ids, silo_indexes):
+            msg = Message(constants.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(msg)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        """(fedml_server_manager.py:121-207)"""
+        sender = msg.get_sender_id()
+        model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+        local_sample_num = msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            self.client_real_ids.index(sender), model_params, local_sample_num
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        self.aggregator.aggregate()
+        self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            self.send_finish()
+            self.finish()
+            return
+        silo_indexes = self.aggregator.data_silo_selection(
+            self.round_idx,
+            int(self.args.client_num_in_total),
+            len(self.client_real_ids),
+        )
+        global_params = self.aggregator.get_global_model_params()
+        for rank, silo_idx in zip(self.client_real_ids, silo_indexes):
+            msg = Message(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
+            msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(msg)
+
+    def send_finish(self) -> None:
+        for rank in self.client_real_ids:
+            self.send_message(
+                Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
+            )
+        logging.info("server: training finished after %d rounds", self.round_idx)

@@ -101,6 +101,13 @@ class FedAvgAPI:
         self.server_state = self._init_server_state()
         self._build_jitted()
 
+        from ..core.tracking import MetricsReporter, ProfilerEvent
+
+        self.profiler = ProfilerEvent(args)
+        # self.history is the round record of truth; the reporter only
+        # fans out to sinks
+        self.metrics_reporter = MetricsReporter(args, keep_history=False)
+
     # -- algorithm hooks ----------------------------------------------
     def _init_server_state(self):
         return ()
@@ -124,8 +131,6 @@ class FedAvgAPI:
 
     # -- engine -------------------------------------------------------
     def _build_jitted(self) -> None:
-        cohort_size = int(self.args.client_num_per_round)
-
         def round_fn(global_params, server_state, packed: Batches, nsamples, idx, rng):
             cohort = _take(packed, idx)
             ns = jnp.take(nsamples, idx)
@@ -143,7 +148,7 @@ class FedAvgAPI:
                 ns = jax.lax.with_sharding_constraint(
                     ns, NamedSharding(self.mesh, P("clients"))
                 )
-            rngs = jax.random.split(rng, cohort_size)
+            rngs = jax.random.split(rng, idx.shape[0])
             new_stacked, train_metrics = jax.vmap(
                 self._local_train, in_axes=(None, 0, 0)
             )(global_params, cohort, rngs)
@@ -189,27 +194,30 @@ class FedAvgAPI:
         )
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
+        ckpt, start_round = self._maybe_restore()
         final_stats: Dict[str, float] = {}
-        for round_idx in range(comm_rounds):
+        for round_idx in range(start_round, comm_rounds):
             t0 = time.perf_counter()
             idx = self._client_sampling(
                 round_idx, self.dataset.client_num, int(args.client_num_per_round)
             )
             self.rng, round_rng = jax.random.split(self.rng)
-            if self.mode == "sequential":
-                new_global, summed = self._sequential_round(idx, round_rng)
-                self.global_params = new_global
-            else:
-                self.global_params, self.server_state, summed = self._round_fn(
-                    self.global_params,
-                    self.server_state,
-                    packed,
-                    nsamples,
-                    jnp.asarray(idx),
-                    round_rng,
-                )
+            with self.profiler.span("round"):
+                if self.mode == "sequential":
+                    new_global, summed = self._sequential_round(idx, round_rng)
+                    self.global_params = new_global
+                else:
+                    self.global_params, self.server_state, summed = self._round_fn(
+                        self.global_params,
+                        self.server_state,
+                        packed,
+                        nsamples,
+                        jnp.asarray(idx),
+                        round_rng,
+                    )
             if round_idx % freq == 0 or round_idx == comm_rounds - 1:
-                stats = self._local_test_on_all_clients(round_idx)
+                with self.profiler.span("eval"):
+                    stats = self._local_test_on_all_clients(round_idx)
                 stats["round"] = round_idx
                 stats["round_time_s"] = time.perf_counter() - t0
                 stats["train_loss_cohort"] = float(summed["loss_sum"]) / max(
@@ -217,8 +225,48 @@ class FedAvgAPI:
                 )
                 self.history.append(stats)
                 final_stats = stats
-                logging.info("round %d: %s", round_idx, stats)
+                self.metrics_reporter.report_server_training_metric(stats)
+            if ckpt is not None and (
+                (round_idx + 1) % self._ckpt_freq == 0
+                or round_idx == comm_rounds - 1
+            ):
+                self._save_checkpoint(ckpt, round_idx)
         return final_stats
+
+    # -- checkpoint / resume (new vs reference — SURVEY.md §5) --------
+    def _maybe_restore(self):
+        ckpt_dir = getattr(self.args, "checkpoint_dir", None)
+        if not ckpt_dir:
+            return None, 0
+        from flax.serialization import from_state_dict, to_state_dict
+
+        from ..core.checkpoint import RoundCheckpointer
+
+        self._ckpt_freq = max(1, int(getattr(self.args, "checkpoint_freq", 10)))
+        ckpt = RoundCheckpointer(ckpt_dir)
+        restored = ckpt.restore()
+        start_round = 0
+        if restored is not None:
+            self.global_params = jax.tree.map(
+                jnp.asarray, from_state_dict(self.global_params, restored["params"])
+            )
+            self.server_state = from_state_dict(
+                self.server_state, restored["server_state"]
+            )
+            self.rng = jnp.asarray(restored["rng"], dtype=jnp.uint32)
+            start_round = int(restored["round_idx"]) + 1
+            logging.info("resuming from round %d", start_round)
+        self._to_state_dict = to_state_dict
+        return ckpt, start_round
+
+    def _save_checkpoint(self, ckpt, round_idx: int) -> None:
+        state = {
+            "params": self.global_params,
+            "server_state": self._to_state_dict(self.server_state),
+            "rng": self.rng,
+            "round_idx": round_idx,
+        }
+        ckpt.save(round_idx, state)
 
     def _sequential_round(self, idx: np.ndarray, rng: jax.Array):
         """Reference §3.1 shape: python loop over sampled clients."""
@@ -322,9 +370,27 @@ class FedNovaAPI(FedAvgAPI):
         return jax.tree.map(combine, global_params, new_stacked), server_state
 
 
-ALGORITHMS = {
-    "FedAvg": FedAvgAPI,
-    "FedProx": FedProxAPI,
-    "FedOpt": FedOptAPI,
-    "FedNova": FedNovaAPI,
-}
+def _algorithms():
+    from .decentralized import DecentralizedDSGDAPI, DecentralizedPushSumAPI
+    from .hierarchical_fl import HierarchicalFLAPI
+
+    return {
+        "FedAvg": FedAvgAPI,
+        "FedProx": FedProxAPI,
+        "FedOpt": FedOptAPI,
+        "FedNova": FedNovaAPI,
+        "HierFedAvg": HierarchicalFLAPI,
+        "DSGD": DecentralizedDSGDAPI,
+        "PushSum": DecentralizedPushSumAPI,
+    }
+
+
+_ALGORITHMS = None
+
+
+def get_algorithms():
+    """Name -> API class registry (lazy to avoid circular imports)."""
+    global _ALGORITHMS
+    if _ALGORITHMS is None:
+        _ALGORITHMS = _algorithms()
+    return _ALGORITHMS

@@ -14,16 +14,17 @@ from __future__ import annotations
 import jax
 
 from ..parallel.mesh import build_mesh, pad_federation, replicate, shard_federation
-from .fedavg_api import ALGORITHMS
+from .fedavg_api import get_algorithms
 
 
 def _select_algorithm(args):
     name = getattr(args, "federated_optimizer", "FedAvg")
-    if name not in ALGORITHMS:
+    algorithms = get_algorithms()
+    if name not in algorithms:
         raise ValueError(
-            f"federated_optimizer {name!r} not supported; have {sorted(ALGORITHMS)}"
+            f"federated_optimizer {name!r} not supported; have {sorted(algorithms)}"
         )
-    return ALGORITHMS[name]
+    return algorithms[name]
 
 
 class SimulatorSingleProcess:
@@ -62,6 +63,11 @@ class SimulatorMesh:
         )
         dataset.packed_num_samples = ns_padded
         cls = _select_algorithm(args)
+        if not getattr(cls, "supports_mesh", True):
+            raise ValueError(
+                f"{cls.__name__} does not support the MESH backend yet; "
+                "run it under the single-process simulator"
+            )
         self.fl_trainer = cls(args, device, dataset, model, mesh=self.mesh)
         self.fl_trainer.global_params = replicate(
             self.fl_trainer.global_params, self.mesh

@@ -155,13 +155,3 @@ class TestLongTailTruncation:
         assert ds.packed_train.x.shape[0] == 30
 
 
-class TestCrossSiloPlaceholder:
-    def test_clear_error(self):
-        import pytest
-
-        from fedml_tpu.cross_silo import Client, Server
-
-        with pytest.raises(NotImplementedError, match="cross-silo"):
-            Client()
-        with pytest.raises(NotImplementedError, match="cross-silo"):
-            Server()

@@ -1,0 +1,165 @@
+"""Hierarchical FL, decentralized gossip, topology, scheduler tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.core.scheduler import (
+    balance_clients_across_shards,
+    dp_schedule,
+    greedy_makespan,
+)
+from fedml_tpu.core.topology import (
+    AsymmetricTopologyManager,
+    SymmetricTopologyManager,
+)
+from fedml_tpu.data import load
+from fedml_tpu.simulation import FedAvgAPI
+from fedml_tpu.simulation.decentralized import (
+    DecentralizedDSGDAPI,
+    DecentralizedPushSumAPI,
+)
+from fedml_tpu.simulation.hierarchical_fl import HierarchicalFLAPI
+
+
+def _setup(make, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=400,
+        synthetic_test_size=80,
+        model="lr",
+        partition_method="homo",
+        client_num_in_total=8,
+        client_num_per_round=8,
+        comm_round=3,
+        epochs=1,
+        batch_size=50,  # full batch per client (400/8 = 50)
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+    )
+    base.update(kw)
+    args = make(**base)
+    args = fedml_tpu.init(args)
+    ds = load(args)
+    model = models.create(args, ds.class_num)
+    return args, ds, model
+
+
+class TestHierarchicalFL:
+    def test_one_group_round_equals_flat_fedavg(self, args_factory):
+        """group_comm_round=1: two-level aggregation collapses to flat
+        FedAvg exactly (the CI oracle's algebra,
+        ci/CI-script-fedavg.sh:53-63)."""
+        args, ds, model = _setup(args_factory, group_num=4, group_comm_round=1)
+        hier = HierarchicalFLAPI(args, None, ds, model)
+        hier.train()
+
+        args2, ds2, model2 = _setup(args_factory)
+        flat = FedAvgAPI(args2, None, ds2, model2)
+        flat.train()
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            hier.global_params,
+            flat.global_params,
+        )
+
+    def test_multi_group_round_runs(self, args_factory):
+        args, ds, model = _setup(
+            args_factory, group_num=2, group_comm_round=3, comm_round=2
+        )
+        hier = HierarchicalFLAPI(args, None, ds, model)
+        stats = hier.train()
+        assert stats["train_acc"] > 0.5
+
+
+class TestDecentralized:
+    def test_dsgd_consensus_tightens(self, args_factory):
+        args, ds, model = _setup(
+            args_factory,
+            partition_method="hetero",
+            comm_round=10,
+            batch_size=16,
+            topology_neighbor_num=4,
+        )
+        api = DecentralizedDSGDAPI(args, None, ds, model)
+        api.train()
+        dists = [h["consensus_dist"] for h in api.history]
+        assert dists[-1] < dists[0]
+        assert api.history[-1]["train_acc"] > 0.5
+
+    def test_pushsum_runs_and_learns(self, args_factory):
+        args, ds, model = _setup(
+            args_factory,
+            partition_method="hetero",
+            comm_round=8,
+            batch_size=16,
+            topology_neighbor_num=2,
+        )
+        api = DecentralizedPushSumAPI(args, None, ds, model)
+        stats = api.train()
+        assert stats["train_acc"] > 0.4
+        # pushsum mass stays positive and sums to n
+        mass = np.asarray(api.mass)
+        assert (mass > 0).all()
+        np.testing.assert_allclose(mass.sum(), len(mass), rtol=1e-4)
+
+
+class TestTopology:
+    def test_symmetric_row_stochastic(self):
+        t = SymmetricTopologyManager(10, neighbor_num=4, seed=1)
+        t.generate_topology()
+        np.testing.assert_allclose(t.topology.sum(axis=1), np.ones(10), atol=1e-9)
+        # symmetric adjacency (support, not necessarily weights)
+        sup = t.topology > 0
+        assert (sup == sup.T).all()
+
+    def test_symmetric_rewiring(self):
+        t0 = SymmetricTopologyManager(12, neighbor_num=2, beta=0.0, seed=3)
+        t0.generate_topology()
+        t1 = SymmetricTopologyManager(12, neighbor_num=2, beta=0.9, seed=3)
+        t1.generate_topology()
+        assert not np.allclose(t0.topology, t1.topology)
+
+    def test_asymmetric_column_stochastic(self):
+        t = AsymmetricTopologyManager(8, neighbor_num=2, seed=0)
+        t.generate_topology()
+        np.testing.assert_allclose(t.topology.sum(axis=0), np.ones(8), atol=1e-9)
+
+    def test_neighbor_lists(self):
+        t = SymmetricTopologyManager(6, neighbor_num=2, seed=0)
+        t.generate_topology()
+        for i in range(6):
+            assert i in t.get_in_neighbor_idx_list(i)  # self loop
+            assert len(t.get_in_neighbor_idx_list(i)) >= 3
+
+
+class TestScheduler:
+    def test_greedy_makespan_bound(self):
+        w = [5, 3, 8, 2, 7, 4, 1]
+        assign, makespan = greedy_makespan(w, 3)
+        all_jobs = sorted(j for bunch in assign for j in bunch)
+        assert all_jobs == list(range(7))
+        assert makespan <= sum(w) / 3 + max(w)  # LPT bound
+
+    def test_dp_schedule_respects_memory(self):
+        w = [5.0, 4.0, 3.0, 2.0]
+        mem = [10.0, 10.0, 1.0, 1.0]
+        caps = [11.0, 11.0]
+        assign = dp_schedule(w, caps, mem)
+        for r, bunch in enumerate(assign):
+            assert sum(mem[j] for j in bunch) <= caps[r] + 1e-9
+
+    def test_balance_clients_even_counts_and_loads(self):
+        sizes = [100, 90, 80, 10, 10, 10, 10, 10]
+        shards = balance_clients_across_shards(sizes, 4)
+        assert sorted(j for s in shards for j in s) == list(range(8))
+        counts = [len(s) for s in shards]
+        assert max(counts) - min(counts) <= 1
+        loads = [sum(sizes[j] for j in s) for s in shards]
+        assert max(loads) - min(loads) <= max(sizes)

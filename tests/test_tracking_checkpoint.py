@@ -1,0 +1,144 @@
+"""Observability + checkpoint/resume tests."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.core.tracking import MetricsReporter, ProfilerEvent
+from fedml_tpu.data import load
+from fedml_tpu.simulation import FedAvgAPI, FedOptAPI
+
+
+def _setup(make, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=240,
+        synthetic_test_size=60,
+        model="lr",
+        partition_method="homo",
+        client_num_in_total=6,
+        client_num_per_round=6,
+        comm_round=4,
+        epochs=1,
+        batch_size=40,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+    )
+    base.update(kw)
+    args = make(**base)
+    args = fedml_tpu.init(args)
+    ds = load(args)
+    model = models.create(args, ds.class_num)
+    return args, ds, model
+
+
+class TestProfiler:
+    def test_spans_accumulate(self):
+        ev = ProfilerEvent()
+        with ev.span("train"):
+            pass
+        with ev.span("train"):
+            pass
+        with ev.span("agg"):
+            pass
+        s = ev.summary()
+        assert s["train"]["count"] == 2
+        assert s["agg"]["count"] == 1
+        assert s["train"]["total_s"] >= 0
+
+    def test_round_loop_is_instrumented(self, args_factory):
+        args, ds, model = _setup(args_factory, comm_round=2)
+        api = FedAvgAPI(args, None, ds, model)
+        api.train()
+        s = api.profiler.summary()
+        assert s["round"]["count"] == 2
+        assert s["eval"]["count"] == 2
+
+
+class TestMetricsReporter:
+    def test_jsonl_sink(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        r = MetricsReporter()
+        r.add_jsonl_sink(path)
+        r.report_server_training_metric({"round": 1, "acc": 0.5})
+        import json
+
+        rec = json.loads(open(path).read().strip())
+        assert rec["kind"] == "server_train"
+        assert rec["round"] == 1
+
+
+class TestCheckpointResume:
+    def _run(self, args_factory, ckpt_dir, rounds, api_cls=FedAvgAPI, **kw):
+        args, ds, model = _setup(args_factory, comm_round=rounds, **kw)
+        args.checkpoint_dir = ckpt_dir
+        args.checkpoint_freq = 1
+        api = api_cls(args, None, ds, model)
+        api.train()
+        return api
+
+    def test_resume_matches_uninterrupted(self, tmp_path, args_factory):
+        """Run 2 rounds + resume for 2 more == one 4-round run."""
+        d = str(tmp_path / "ck")
+        self._run(args_factory, d, rounds=2)
+        resumed = self._run(args_factory, d, rounds=4)
+
+        args, ds, model = _setup(args_factory, comm_round=4)
+        straight = FedAvgAPI(args, None, ds, model)
+        straight.train()
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            resumed.global_params,
+            straight.global_params,
+        )
+
+    def test_resume_restores_server_optimizer_state(self, tmp_path, args_factory):
+        """FedOpt/adam: optimizer moments must survive the restart."""
+        d = str(tmp_path / "ck2")
+        self._run(
+            args_factory,
+            d,
+            rounds=2,
+            api_cls=FedOptAPI,
+            server_optimizer="adam",
+            server_lr=0.05,
+        )
+        resumed = self._run(
+            args_factory,
+            d,
+            rounds=4,
+            api_cls=FedOptAPI,
+            server_optimizer="adam",
+            server_lr=0.05,
+        )
+        args, ds, model = _setup(
+            args_factory, comm_round=4, server_optimizer="adam", server_lr=0.05
+        )
+        args.federated_optimizer = "FedOpt"
+        straight = FedOptAPI(args, None, ds, model)
+        straight.train()
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            resumed.global_params,
+            straight.global_params,
+        )
+
+    def test_completed_run_does_not_retrain(self, tmp_path, args_factory):
+        d = str(tmp_path / "ck3")
+        api1 = self._run(args_factory, d, rounds=3)
+        api2 = self._run(args_factory, d, rounds=3)  # already done
+        assert api2.history == []  # no rounds executed
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+            api1.global_params,
+            api2.global_params,
+        )
