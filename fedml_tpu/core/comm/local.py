@@ -20,7 +20,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from collections import defaultdict
 from typing import Dict, List
 
 from ..message import Message
@@ -36,7 +35,16 @@ class _Fabric:
     _lock = threading.Lock()
 
     def __init__(self) -> None:
-        self.inboxes: Dict[int, "queue.Queue"] = defaultdict(queue.Queue)
+        # plain dict + locked creation: defaultdict.__missing__ is not
+        # atomic, and a lost first-touch race would orphan a rank's
+        # inbox (messages enqueued to the overwritten queue vanish)
+        self.inboxes: Dict[int, "queue.Queue"] = {}
+
+    def inbox(self, rank: int) -> "queue.Queue":
+        with _Fabric._lock:
+            if rank not in self.inboxes:
+                self.inboxes[rank] = queue.Queue()
+            return self.inboxes[rank]
 
     @classmethod
     def get(cls, name: str) -> "_Fabric":
@@ -62,7 +70,7 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message) -> None:
         receiver = int(msg.get_receiver_id())
-        self.fabric.inboxes[receiver].put(msg)
+        self.fabric.inbox(receiver).put(msg)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -73,7 +81,7 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
     def handle_receive_message(self) -> None:
         self._running = True
-        inbox = self.fabric.inboxes[self.rank]
+        inbox = self.fabric.inbox(self.rank)
         while self._running:
             item = inbox.get()
             if item is _STOP:
@@ -87,7 +95,7 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self.fabric.inboxes[self.rank].put(_STOP)
+        self.fabric.inbox(self.rank).put(_STOP)
 
     def destroy_fabric(self) -> None:
         """Drop the fabric from the process-global registry so a later

@@ -62,9 +62,22 @@ def dp_schedule(
         loads[r] += workloads[j]
         mem_used[r] += memory[j]
     if mode == 1:
-        # parallel mode: round-robin within each resource's bunch to
-        # interleave large/small jobs (scheduler.py parallel branch)
-        assign = [sorted(b, key=lambda j_: -workloads[j_]) for b in assign]
+        # parallel mode: interleave large/small jobs inside each bunch
+        # (scheduler.py parallel branch) so concurrent lanes on one
+        # resource start with mixed workloads instead of all-large-first
+        def interleave(b: List[int]) -> List[int]:
+            s = sorted(b, key=lambda j_: -workloads[j_])
+            out: List[int] = []
+            lo, hi = 0, len(s) - 1
+            while lo <= hi:
+                out.append(s[lo])
+                if lo != hi:
+                    out.append(s[hi])
+                lo += 1
+                hi -= 1
+            return out
+
+        assign = [interleave(b) for b in assign]
     return assign
 
 

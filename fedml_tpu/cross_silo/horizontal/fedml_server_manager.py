@@ -68,14 +68,24 @@ class FedMLServerManager(ServerManager):
 
     def send_init_msg(self) -> None:
         """(fedml_server_manager.py:47-69)"""
+        self._broadcast_model(constants.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _broadcast_model(self, msg_type: str) -> None:
+        """Selection + model broadcast shared by init and per-round sync
+        (fedml_server_manager.py:47-69 and :167-207): pick which edge
+        ranks participate (``client_selection``), map them onto data-silo
+        indices (``data_silo_selection``), send the global model."""
+        receiver_ranks = self.aggregator.client_selection(
+            self.round_idx, self.client_real_ids, len(self.client_real_ids)
+        )
         silo_indexes = self.aggregator.data_silo_selection(
             self.round_idx,
             int(self.args.client_num_in_total),
-            len(self.client_real_ids),
+            len(receiver_ranks),
         )
         global_params = self.aggregator.get_global_model_params()
-        for rank, silo_idx in zip(self.client_real_ids, silo_indexes):
-            msg = Message(constants.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+        for rank, silo_idx in zip(receiver_ranks, silo_indexes):
+            msg = Message(msg_type, self.rank, rank)
             msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
@@ -98,18 +108,7 @@ class FedMLServerManager(ServerManager):
             self.send_finish()
             self.finish()
             return
-        silo_indexes = self.aggregator.data_silo_selection(
-            self.round_idx,
-            int(self.args.client_num_in_total),
-            len(self.client_real_ids),
-        )
-        global_params = self.aggregator.get_global_model_params()
-        for rank, silo_idx in zip(self.client_real_ids, silo_indexes):
-            msg = Message(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
-            msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
-            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-            self.send_message(msg)
+        self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
     def send_finish(self) -> None:
         for rank in self.client_real_ids:

@@ -14,6 +14,9 @@ from .linear import LogisticRegression, MLP
 from .cnn import CNNFedAvg, CNNCifar
 from .resnet import resnet18_gn, resnet56
 from .rnn import RNNOriginalFedAvg, RNNStackOverflow
+from .mobilenet import MobileNetV1, MobileNetV3Small
+from .vgg import vgg
+from .efficientnet import efficientnet
 
 __all__ = ["FedModel", "create"]
 
@@ -81,6 +84,34 @@ def create(args, output_dim: int) -> FedModel:
         return FedModel(
             name="resnet56",
             module=resnet56(output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name == "mobilenet":
+        return FedModel(
+            name="mobilenet",
+            module=MobileNetV1(output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name in ("mobilenet_v3", "mobilenetv3"):
+        return FedModel(
+            name="mobilenet_v3",
+            module=MobileNetV3Small(output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name.startswith("vgg"):
+        return FedModel(
+            name=name,
+            module=vgg(name, output_dim),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
+    if name.startswith("efficientnet"):
+        return FedModel(
+            name=name,
+            module=efficientnet(name, output_dim),
             task="classification",
             example_shape=_example_shape(args, (32, 32, 3)),
         )

@@ -1,0 +1,111 @@
+"""EfficientNet (lite-style) with GroupNorm, NHWC.
+
+Reference: ``python/fedml/model/cv/efficientnet.py`` (EfficientNet-B0..7
+via width/depth scaling of the MBConv plan). This build keeps the same
+compound-scaling structure but uses GN (pure-param pytree) and drops
+drop-connect (stochastic depth needs per-call RNG threading; FL clients
+already regularize via local epochs — can be added through the rngs arg
+later). CIFAR-sized stem (stride 1).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .mobilenet import SqueezeExcite, _gn
+
+# (expand_ratio, channels, repeats, strides, kernel)
+_BASE_PLAN: Tuple[Tuple[int, int, int, int, int], ...] = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+# (width_mult, depth_mult) per variant (efficientnet.py params)
+_SCALING = {
+    "efficientnet-b0": (1.0, 1.0),
+    "efficientnet-b1": (1.0, 1.1),
+    "efficientnet-b2": (1.1, 1.2),
+    "efficientnet-b3": (1.2, 1.4),
+    "efficientnet-b4": (1.4, 1.8),
+}
+
+
+def _round_channels(ch: float, divisor: int = 8) -> int:
+    out = max(divisor, int(ch + divisor / 2) // divisor * divisor)
+    if out < 0.9 * ch:
+        out += divisor
+    return out
+
+
+class MBConv(nn.Module):
+    channels: int
+    expand_ratio: int
+    kernel: int = 3
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand_ratio
+        y = x
+        if self.expand_ratio != 1:
+            y = nn.Conv(mid, (1, 1), use_bias=False)(y)
+            y = _gn(mid)(y)
+            y = nn.swish(y)
+        y = nn.Conv(
+            mid,
+            (self.kernel, self.kernel),
+            strides=(self.strides, self.strides),
+            feature_group_count=mid,
+            use_bias=False,
+        )(y)
+        y = _gn(mid)(y)
+        y = nn.swish(y)
+        y = SqueezeExcite(reduce=4 * self.expand_ratio)(y)
+        y = nn.Conv(self.channels, (1, 1), use_bias=False)(y)
+        y = _gn(self.channels)(y)
+        if self.strides == 1 and in_ch == self.channels:
+            y = y + inp
+        return y
+
+
+class EfficientNet(nn.Module):
+    output_dim: int
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        stem = _round_channels(32 * self.width_mult)
+        x = nn.Conv(stem, (3, 3), use_bias=False)(x)
+        x = _gn(stem)(x)
+        x = nn.swish(x)
+        for expand, ch, repeats, strides, kernel in _BASE_PLAN:
+            ch = _round_channels(ch * self.width_mult)
+            reps = int(math.ceil(repeats * self.depth_mult))
+            for i in range(reps):
+                x = MBConv(ch, expand, kernel, strides if i == 0 else 1)(x)
+        head = _round_channels(1280 * self.width_mult)
+        x = nn.Conv(head, (1, 1), use_bias=False)(x)
+        x = _gn(head)(x)
+        x = nn.swish(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.output_dim)(x)
+
+
+def efficientnet(name: str, output_dim: int) -> EfficientNet:
+    if name not in _SCALING:
+        raise ValueError(f"unknown efficientnet variant {name!r}")
+    w, d = _SCALING[name]
+    return EfficientNet(output_dim=output_dim, width_mult=w, depth_mult=d)

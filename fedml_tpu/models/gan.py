@@ -1,0 +1,52 @@
+"""MNIST GAN pair (generator + discriminator).
+
+Reference: ``python/fedml/model/cv/mnist_gan.py`` consumed by the
+``simulation/mpi_p2p_mp/fedgan`` algorithm. DCGAN-shaped: the generator
+upsamples a latent vector to 28x28x1 via transposed convs; the
+discriminator mirrors it down to one logit. GN replaces BN (pure-param
+pytrees — both nets are FedAvg'd across clients in FedGAN).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z [B, latent_dim] -> image [B, 28, 28, 1] in tanh range."""
+
+    latent_dim: int = 64
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        x = nn.Dense(7 * 7 * 128)(z)
+        x = x.reshape((z.shape[0], 7, 7, 128))
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.relu(x)
+        x = nn.ConvTranspose(64, (4, 4), strides=(2, 2))(x)  # 14x14
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.relu(x)
+        x = nn.ConvTranspose(32, (4, 4), strides=(2, 2))(x)  # 28x28
+        x = nn.GroupNorm(num_groups=16)(x)
+        x = nn.relu(x)
+        x = nn.Conv(1, (3, 3))(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image [B, 28, 28, 1] -> real/fake logit [B]."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        x = nn.Conv(32, (4, 4), strides=(2, 2))(x)  # 14x14
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(64, (4, 4), strides=(2, 2))(x)  # 7x7
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(128, (4, 4), strides=(2, 2))(x)  # 4x4
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1)(x)[..., 0]

@@ -1,0 +1,59 @@
+"""FedGKT model pair: small client extractor + large server net.
+
+Reference: ``python/fedml/model/cv/resnet56_gkt/`` — ResNet-8 on the
+client (feature extractor + tiny local head) paired with ResNet-55/109
+on the server, which consumes the client's feature maps instead of raw
+images (``fedgkt/GKTServerTrainer.py:13-300``). Here both are GN
+ResNets sharing `resnet.BasicBlock`; the client exposes
+(features, logits) and the server starts from the feature shape.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import BasicBlock, _gn
+
+
+class GKTClientNet(nn.Module):
+    """Stem + one stage; returns (feature_map, local_logits)
+    (resnet8_56 client: extractor + classifier head)."""
+
+    output_dim: int
+    channels: int = 16
+    blocks: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = x.astype(jnp.float32)
+        x = nn.Conv(self.channels, (3, 3), use_bias=False)(x)
+        x = _gn(self.channels)(x)
+        x = nn.relu(x)
+        for _ in range(self.blocks):
+            x = BasicBlock(self.channels)(x, train)
+        features = x
+        pooled = x.mean(axis=(1, 2))
+        logits = nn.Dense(self.output_dim)(pooled)
+        return features, logits
+
+
+class GKTServerNet(nn.Module):
+    """Deep tail over client feature maps (resnet56/110 server side,
+    ``resnet56_gkt/resnet_server.py``): stages of GN blocks then head."""
+
+    output_dim: int
+    stage_sizes: Sequence[int] = (8, 9, 9)
+    stage_channels: Sequence[int] = (16, 32, 64)
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        x = features
+        for i, (size, ch) in enumerate(zip(self.stage_sizes, self.stage_channels)):
+            for j in range(size):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = BasicBlock(ch, strides)(x, train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,168 @@
+"""MobileNet v1/v3 with GroupNorm, NHWC.
+
+Reference models: ``python/fedml/model/cv/mobilenet.py`` (MobileNetV1,
+the BENCHMARK_MPI MobileNet rows) and ``python/fedml/model/cv/
+mobilenet_v3.py``. BatchNorm is replaced by GroupNorm everywhere (same
+rationale as resnet.py: pure-param pytrees, FL-friendly under non-IID).
+Depthwise convs use ``feature_group_count`` — XLA lowers these to the
+TPU's native depthwise path; the pointwise 1x1 convs are MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(channels: int) -> nn.GroupNorm:
+    # largest group count <= 32 that divides the channel count (GN
+    # requires exact divisibility; mobilenet widths like 40/88/576 are
+    # not powers of two)
+    g = next(g for g in range(min(32, channels), 0, -1) if channels % g == 0)
+    return nn.GroupNorm(num_groups=g)
+
+
+class DepthwiseSeparable(nn.Module):
+    """dw 3x3 + pw 1x1 (mobilenet.py conv_dw block)."""
+
+    channels: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch,
+            (3, 3),
+            strides=(self.strides, self.strides),
+            feature_group_count=in_ch,
+            use_bias=False,
+        )(x)
+        x = _gn(in_ch)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.channels, (1, 1), use_bias=False)(x)
+        x = _gn(self.channels)(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """MobileNetV1 (mobilenet.py), CIFAR-sized stem (stride-1 3x3)."""
+
+    output_dim: int
+    width: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+
+        def c(ch: int) -> int:
+            return max(8, int(ch * self.width))
+
+        x = nn.Conv(c(32), (3, 3), use_bias=False)(x)
+        x = _gn(c(32))(x)
+        x = nn.relu(x)
+        plan: Sequence[Tuple[int, int]] = (
+            (64, 1),
+            (128, 2),
+            (128, 1),
+            (256, 2),
+            (256, 1),
+            (512, 2),
+            *(((512, 1),) * 5),
+            (1024, 2),
+            (1024, 1),
+        )
+        for ch, s in plan:
+            x = DepthwiseSeparable(c(ch), s)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.output_dim)(x)
+
+
+def _hardswish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = x.mean(axis=(1, 2))
+        s = nn.relu(nn.Dense(max(8, ch // self.reduce))(s))
+        s = nn.relu6(nn.Dense(ch)(s) + 3.0) / 6.0  # hard-sigmoid
+        return x * s[:, None, None, :]
+
+
+class MBConvV3(nn.Module):
+    """MobileNetV3 bottleneck: expand pw -> dw -> SE -> project pw."""
+
+    channels: int
+    expand: int
+    kernel: int = 3
+    strides: int = 1
+    use_se: bool = False
+    use_hs: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        act = _hardswish if self.use_hs else nn.relu
+        inp = x
+        mid = self.expand
+        y = nn.Conv(mid, (1, 1), use_bias=False)(x)
+        y = _gn(mid)(y)
+        y = act(y)
+        y = nn.Conv(
+            mid,
+            (self.kernel, self.kernel),
+            strides=(self.strides, self.strides),
+            feature_group_count=mid,
+            use_bias=False,
+        )(y)
+        y = _gn(mid)(y)
+        y = act(y)
+        if self.use_se:
+            y = SqueezeExcite()(y)
+        y = nn.Conv(self.channels, (1, 1), use_bias=False)(y)
+        y = _gn(self.channels)(y)
+        if self.strides == 1 and inp.shape[-1] == self.channels:
+            y = y + inp
+        return y
+
+
+class MobileNetV3Small(nn.Module):
+    """MobileNetV3-small body (mobilenet_v3.py 'small' config),
+    CIFAR-sized stem."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        x = nn.Conv(16, (3, 3), use_bias=False)(x)
+        x = _gn(16)(x)
+        x = _hardswish(x)
+        # (channels, expand, kernel, strides, se, hs)
+        plan = (
+            (16, 16, 3, 2, True, False),
+            (24, 72, 3, 2, False, False),
+            (24, 88, 3, 1, False, False),
+            (40, 96, 5, 2, True, True),
+            (40, 240, 5, 1, True, True),
+            (40, 240, 5, 1, True, True),
+            (48, 120, 5, 1, True, True),
+            (48, 144, 5, 1, True, True),
+            (96, 288, 5, 2, True, True),
+            (96, 576, 5, 1, True, True),
+            (96, 576, 5, 1, True, True),
+        )
+        for ch, ex, k, s, se, hs in plan:
+            x = MBConvV3(ch, ex, k, s, se, hs)(x)
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = _gn(576)(x)
+        x = _hardswish(x)
+        x = x.mean(axis=(1, 2))
+        x = _hardswish(nn.Dense(1024)(x))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,42 @@
+"""Vertical-FL party models.
+
+Reference: ``python/fedml/model/finance/vfl_*.py`` — per-party "local
+model" (a dense feature extractor over that party's feature slice) plus
+the guest's "dense model" (interactive/top layer over summed party
+outputs), used by ``classical_vertical_fl`` (guest aggregates host
+logits, backprops gradient slices to hosts,
+``guest_trainer.py:91-153``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PartyLocalModel(nn.Module):
+    """One party's bottom net over its private feature slice
+    (vfl_models.py local models: Dense->relu stack -> representation)."""
+
+    hidden_dims: Sequence[int] = (32,)
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        for h in self.hidden_dims:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.output_dim)(x)
+
+
+class GuestTopModel(nn.Module):
+    """Guest's top model over the summed party representations
+    (the 'interactive layer' + classifier in vfl_models.py)."""
+
+    output_dim: int = 1
+
+    @nn.compact
+    def __call__(self, rep, train: bool = False):
+        return nn.Dense(self.output_dim)(rep)

@@ -195,6 +195,18 @@ class FedAvgAPI:
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
         ckpt, start_round = self._maybe_restore()
+        try:
+            return self._train_rounds(
+                packed, nsamples, comm_rounds, freq, ckpt, start_round
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+
+    def _train_rounds(
+        self, packed, nsamples, comm_rounds, freq, ckpt, start_round
+    ) -> Dict[str, float]:
+        args = self.args
         final_stats: Dict[str, float] = {}
         for round_idx in range(start_round, comm_rounds):
             t0 = time.perf_counter()

@@ -7,6 +7,7 @@ round loop produces the SAME global model as the single-process
 simulator on identical data/config — transport is a layout choice.
 """
 
+import random
 import socket
 import threading
 
@@ -74,15 +75,26 @@ def _run_world(args_factory, run_id, backend, port_base=None, n_clients=4, **kw)
     return server
 
 
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    base = min(s.getsockname()[1] for s in socks)
-    ports = sorted(s.getsockname()[1] for s in socks)
-    for s in socks:
-        s.close()
-    return ports
+def _free_port_block(n, attempts=50):
+    """Find a CONTIGUOUS block of n free ports: grpc binds
+    port_base + rank, so every port in [base, base+n) must be free —
+    individually-free ephemeral ports don't guarantee that."""
+    rng = random.Random()
+    for _ in range(attempts):
+        base = rng.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no contiguous {n}-port block found")
 
 
 class TestMessage:
@@ -135,9 +147,7 @@ class TestCrossSiloLocal:
 
 class TestCrossSiloGrpc:
     def test_round_loop_over_grpc(self, args_factory):
-        ports = _free_ports(6)
-        base = ports[0]
-        # ensure base..base+4 are plausibly free; bind failures raise
+        base = _free_port_block(4)
         server = _run_world(
             args_factory,
             run_id="csg",
@@ -151,12 +161,11 @@ class TestCrossSiloGrpc:
         assert server.manager.round_idx == 2
 
     def test_grpc_matches_local(self, args_factory):
-        ports = _free_ports(6)
         s1 = _run_world(
             args_factory,
             run_id="csg2",
             backend="GRPC",
-            port_base=ports[0] + 17,
+            port_base=_free_port_block(4),
             comm_round=2,
             client_num_in_total=3,
             client_num_per_round=3,
