@@ -1,0 +1,280 @@
+"""Fork-specific poisoning defenses: S-FedAvg and HS-FedAvg.
+
+Reference parity (behavior, not implementation):
+
+- **S-FedAvg** — ``simulation/single_process/s_fedavg/fedavg_api.py``:
+  Shapley-value client scoring. Each round, after local training, the
+  server estimates every cohort member's Shapley value against an
+  aggregator-held validation set (Monte-Carlo over permutations until
+  the SV estimate converges in Euclidean distance — ``isApproached``,
+  fedavg_api.py:138-146), updates a per-client reputation
+  ``phi = alpha*phi + beta*sv`` (fedavg_api.py:252-258), and biases the
+  next round's sampling by ``exp(phi)`` (``sampling_filter="exp"``,
+  fedavg_api.py:435-477). Scoring metrics: accuracy, or per-target-label
+  Recall / Precision / F1 for backdoor detection (fedavg_api.py:218-226,
+  :428-433).
+
+  TPU-first redesign: one permutation's full prefix sweep is a SINGLE
+  jitted computation — prefix aggregates are a cumulative weighted sum
+  along the (permuted) client axis and all C prefix models are evaluated
+  on the validation set with ``vmap``. The reference instead deep-copies
+  the model and re-runs torch eval C times per permutation in Python
+  (fedavg_api.py:210-236). Note: the reference shuffles an index list
+  but slices ``w_locals`` unpermuted, so its "permutations" never change
+  order; we implement the actual MC-Shapley it intends.
+
+- **HS-FedAvg** — ``simulation/single_process/hs_fedavg/hs_fft.py``:
+  FFT amplitude-spectrum input normalization. A running mean amplitude
+  spectrum is maintained with momentum (``process()``, hs_fft.py:60+)
+  and every training image's low-frequency amplitude band (band
+  half-width ``floor(min(H,W)*L)`` around the centred DC, ``mutate``,
+  hs_fft.py:16-37; the reference calls it with L=0 → DC only) is
+  replaced by the running spectrum while phases are kept
+  (``normalize``, hs_fft.py:40-56). Here the whole transform is a
+  batched ``jnp.fft`` computation fused into the jitted round — the
+  reference loops per-image in numpy on host.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.aggregation import normalize_weights
+from ..core.types import Batches
+from .fedavg_api import FedAvgAPI
+
+Params = Any
+
+
+# ---------------------------------------------------------------------------
+# S-FedAvg
+# ---------------------------------------------------------------------------
+
+
+def _take_batches(b: Batches, n: int) -> Batches:
+    return Batches(x=b.x[:n], y=b.y[:n], mask=b.mask[:n])
+
+
+class SFedAvgAPI(FedAvgAPI):
+    """Shapley-value client scoring defense (S-FedAvg).
+
+    Extra args (defaults follow the fork's experiment configs):
+      ``sfedavg_alpha`` / ``sfedavg_beta`` — reputation EMA coefficients;
+      ``sampling_filter`` — ``"exp"`` biases sampling by ``exp(phi)``;
+      ``score_method`` — ``"acc" | "F1" | "Recall" | "Precision"``;
+      ``target_label`` — class watched for backdoor suppression (int or
+      None); ``sv_max_perms`` — permutation cap (reference caps at
+      cohort**2 distance samples); ``sv_tol`` — convergence limit
+      (reference ``approaching_limit=0.005``); ``valid_batches`` —
+      number of global-test batches held out as the aggregator's
+      validation set (reference: dedicated ``valid_data_in_aggregator``).
+    """
+
+    algorithm = "SFedAvg"
+    _keep_stacked = True
+
+    def __init__(self, args, device, dataset, model, mesh=None) -> None:
+        super().__init__(args, device, dataset, model, mesh=mesh)
+        K = dataset.client_num
+        self.alpha = float(getattr(args, "sfedavg_alpha", 0.5))
+        self.beta = float(getattr(args, "sfedavg_beta", 0.5))
+        self.sampling_filter = getattr(args, "sampling_filter", "exp")
+        self.score_method = str(getattr(args, "score_method", "acc"))
+        self.target_label = getattr(args, "target_label", None)
+        self.sv_tol = float(getattr(args, "sv_tol", 0.005))
+        self.sv_max_perms = int(
+            getattr(args, "sv_max_perms", int(args.client_num_per_round) ** 2)
+        )
+        nval = int(getattr(args, "valid_batches", 4))
+        self.val_data = _take_batches(
+            self.dataset.test_data_global, max(1, min(nval, self.dataset.test_data_global.mask.shape[0]))
+        )
+        # reputation state (fedavg_api.py:152-163)
+        self.phi = np.full((K,), 1.0 / K, dtype=np.float64)
+        self.sv = np.full((K,), (1.0 - self.alpha) / (K * self.beta), dtype=np.float64)
+        self.sv_history: List[Dict[str, float]] = []
+        self._build_shapley()
+
+    # -- scoring ------------------------------------------------------
+    def _build_shapley(self) -> None:
+        apply_fn = self.model.apply
+        tgt = self.target_label
+        method = self.score_method
+
+        def score(params, val: Batches) -> jax.Array:
+            def step(carry, batch):
+                x, y, m = batch
+                pred = jnp.argmax(apply_fn(params, x), axis=-1)
+                correct = ((pred == y) * m).sum()
+                out = {"correct": correct, "count": m.sum()}
+                if tgt is not None:
+                    is_t = (y == tgt).astype(m.dtype) * m
+                    pred_t = (pred == tgt).astype(m.dtype) * m
+                    out["tp"] = (is_t * pred_t).sum()
+                    out["fp"] = ((1 - (y == tgt)) * pred_t * m).sum()
+                    out["fn"] = (is_t * (1 - (pred == tgt))).sum()
+                return carry, out
+
+            _, sums = jax.lax.scan(step, None, (val.x, val.y, val.mask))
+            s = jax.tree.map(lambda a: a.sum(), sums)
+            acc = s["correct"] / jnp.maximum(s["count"], 1.0)
+            if tgt is None or method in ("acc", "Accuracy"):
+                return acc
+            prec = s["tp"] / jnp.maximum(s["tp"] + s["fp"], 1.0)
+            rec = s["tp"] / jnp.maximum(s["tp"] + s["fn"], 1.0)
+            if method in ("Precision", "PPV", "ppv"):
+                return prec
+            if method in ("Sensitivity", "Recall", "TPR", "tpr"):
+                return rec
+            return 2.0 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+
+        def shapley_perm(stacked: Params, weights: jax.Array, perm: jax.Array, val: Batches):
+            w = jnp.take(weights, perm)
+            cw = jnp.cumsum(w)
+
+            def prefix(leaf: jax.Array) -> jax.Array:
+                s = jnp.take(leaf, perm, axis=0)
+                wr = w.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+                cwr = cw.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+                return jnp.cumsum(wr * s, axis=0) / jnp.maximum(cwr, 1e-12)
+
+            prefix_models = jax.tree.map(prefix, stacked)
+            scores = jax.vmap(score, in_axes=(0, None))(prefix_models, val)  # [C]
+            marg = scores - jnp.concatenate([jnp.zeros((1,)), scores[:-1]])
+            # scatter marginals back to cohort slots
+            return jnp.zeros_like(marg).at[perm].set(marg)
+
+        self._shapley_perm = jax.jit(shapley_perm)
+
+    def _is_approached(self, d: List[float], cohort: int) -> bool:
+        """Reference convergence test (fedavg_api.py:138-146)."""
+        if len(d) >= self.sv_max_perms:
+            return False
+        if len(d) <= cohort:
+            return True
+        return any(x >= self.sv_tol for x in d[-3:])
+
+    def _post_round_stacked(self, stacked: Params, idx: np.ndarray, rng) -> None:
+        C = int(idx.shape[0])
+        ns = jnp.take(jnp.asarray(self.dataset.packed_num_samples), jnp.asarray(idx))
+        weights = normalize_weights(ns)
+        sv_est = np.zeros((C,), dtype=np.float64)
+        cnt = 0
+        d: List[float] = []
+        perm_rng = np.random.default_rng(int(jax.random.randint(rng, (), 0, 2**31 - 1)))
+        while self._is_approached(d, C):
+            perm = jnp.asarray(perm_rng.permutation(C))
+            sv_new = np.asarray(self._shapley_perm(stacked, weights, perm, self.val_data))
+            sv_next = (cnt * sv_est + sv_new) / (cnt + 1)
+            if cnt:
+                d.append(float(np.linalg.norm(sv_next - sv_est)))
+            sv_est = sv_next
+            cnt += 1
+        # reputation update (fedavg_api.py:252-258)
+        for j, client_idx in enumerate(np.asarray(idx)):
+            self.sv[client_idx] = sv_est[j]
+            self.phi[client_idx] = (
+                self.alpha * self.phi[client_idx] + self.beta * self.sv[client_idx]
+            )
+        self.sv_history.append(
+            {"perms": cnt, "sv_mean": float(sv_est.mean()), "phi_min": float(self.phi.min())}
+        )
+        logging.debug("S-FedAvg: %d permutations, sv=%s", cnt, sv_est)
+
+    # -- reputation-biased sampling (fedavg_api.py:435-477) -----------
+    def _client_sampling(self, round_idx, client_num_in_total, client_num_per_round):
+        if client_num_in_total == client_num_per_round:
+            return np.arange(client_num_in_total, dtype=np.int32)
+        if self.sampling_filter == "exp":
+            p = np.exp(self.phi)
+        else:
+            p = np.ones((client_num_in_total,))
+        p = p / (p.sum() + 1e-13)
+        np.random.seed(round_idx)
+        return np.asarray(
+            np.random.choice(
+                range(client_num_in_total), client_num_per_round, replace=False, p=p
+            ),
+            dtype=np.int32,
+        )
+
+
+# ---------------------------------------------------------------------------
+# HS-FedAvg
+# ---------------------------------------------------------------------------
+
+
+def make_hs_normalizer(h: int, w: int, L: float, momentum: float):
+    """Build the jitted FFT amplitude-normalization transform.
+
+    Returns ``normalize(x, mask, running_amp) -> (x', running_amp')``
+    where ``x`` is ``[..., H, W, C]`` with per-example validity ``mask``
+    of shape ``x.shape[:-3]``. Band semantics follow ``hs_fft.mutate``:
+    half-width ``b = floor(min(H,W)*L)`` around the fftshifted centre.
+    """
+    b = int(np.floor(min(h, w) * L))
+    ch, cw = h // 2, w // 2
+    band_np = np.zeros((h, w, 1), np.float32)
+    band_np[max(ch - b, 0) : ch + b + 1, max(cw - b, 0) : cw + b + 1] = 1.0
+    band = jnp.asarray(band_np)
+
+    def normalize(x: jax.Array, mask: jax.Array, running_amp: jax.Array):
+        xf = x.astype(jnp.float32)
+        fft = jnp.fft.fft2(xf, axes=(-3, -2))
+        amp = jnp.abs(fft)
+        pha = jnp.angle(fft)
+        mexp = mask.reshape(mask.shape + (1, 1, 1)).astype(jnp.float32)
+        lead = tuple(range(mask.ndim))
+        batch_amp = (amp * mexp).sum(axis=lead) / jnp.maximum(mexp.sum(), 1.0)
+        new_running = jnp.where(
+            running_amp.sum() == 0.0,
+            batch_amp,
+            running_amp * (1.0 - momentum) + batch_amp * momentum,
+        )
+        a_src = jnp.fft.fftshift(amp, axes=(-3, -2))
+        a_trg = jnp.fft.fftshift(new_running, axes=(0, 1))
+        a_new = a_src * (1.0 - band) + a_trg * band
+        fft_new = jnp.fft.ifftshift(a_new, axes=(-3, -2)) * jnp.exp(1j * pha)
+        x_new = jnp.real(jnp.fft.ifft2(fft_new, axes=(-3, -2)))
+        return jnp.where(mexp > 0, x_new, xf).astype(x.dtype), new_running
+
+    return normalize
+
+
+class HSFedAvgAPI(FedAvgAPI):
+    """FFT amplitude-spectrum defense (HS-FedAvg).
+
+    The running amplitude spectrum lives in ``server_state`` and is
+    threaded through the jitted round; the cohort's images are
+    normalized in-jit before local training. Extra args: ``hs_L``
+    (band ratio, reference uses 0.0 → DC only), ``hs_momentum``
+    (reference 0.1). Requires vectorized mode and image data.
+    """
+
+    algorithm = "HSFedAvg"
+
+    def __init__(self, args, device, dataset, model, mesh=None) -> None:
+        shape = dataset.packed_train.x.shape
+        if len(shape) != 6:
+            raise ValueError("HS-FedAvg needs image data [C, nb, bs, H, W, ch]")
+        self._img_hw = (int(shape[-3]), int(shape[-2]), int(shape[-1]))
+        self._normalize = make_hs_normalizer(
+            self._img_hw[0],
+            self._img_hw[1],
+            float(getattr(args, "hs_L", 0.0)),
+            float(getattr(args, "hs_momentum", 0.1)),
+        )
+        super().__init__(args, device, dataset, model, mesh=mesh)
+
+    def _init_server_state(self):
+        h, w, c = self._img_hw
+        return jnp.zeros((h, w, c), jnp.float32)
+
+    def _preprocess(self, cohort: Batches, server_state):
+        x_new, new_amp = self._normalize(cohort.x, cohort.mask, server_state)
+        return Batches(x=x_new, y=cohort.y, mask=cohort.mask), new_amp

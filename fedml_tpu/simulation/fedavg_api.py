@@ -51,6 +51,22 @@ def _take(b: Batches, idx: jax.Array) -> Batches:
     )
 
 
+def deterministic_client_sampling(
+    round_idx: int, client_num_in_total: int, client_num_per_round: int
+) -> np.ndarray:
+    """Reference determinism contract: ``np.random.seed(round_idx)``
+    then ``choice`` without replacement (FedAVGAggregator.py:99-113)."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int32)
+    np.random.seed(round_idx)
+    return np.asarray(
+        np.random.choice(
+            range(client_num_in_total), client_num_per_round, replace=False
+        ),
+        dtype=np.int32,
+    )
+
+
 class FedAvgAPI:
     """Single-host simulator for the FedAvg family.
 
@@ -60,6 +76,10 @@ class FedAvgAPI:
     """
 
     algorithm = "FedAvg"
+    # subclasses that need per-client params on the host (Shapley
+    # scoring, secure aggregation) flip this to get the stacked cohort
+    # params as a 4th round output
+    _keep_stacked = False
 
     def __init__(
         self,
@@ -75,6 +95,14 @@ class FedAvgAPI:
         self.model = model
         self.mesh = mesh
         self.mode = getattr(args, "sim_mode", "vectorized")
+        if self.mode == "sequential" and (
+            self._keep_stacked
+            or type(self)._preprocess is not FedAvgAPI._preprocess
+        ):
+            raise NotImplementedError(
+                f"{self.algorithm} uses in-round hooks that only run in "
+                "vectorized mode; sim_mode='sequential' is not supported"
+            )
         self.history: List[Dict[str, float]] = []
 
         self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
@@ -129,6 +157,11 @@ class FedAvgAPI:
             )
         return weighted_average(new_stacked, weights), server_state
 
+    def _preprocess(self, cohort: Batches, server_state):
+        """In-jit hook applied to the gathered cohort before local
+        training (HS-FedAvg's FFT input normalization plugs in here)."""
+        return cohort, server_state
+
     # -- engine -------------------------------------------------------
     def _build_jitted(self) -> None:
         def round_fn(global_params, server_state, packed: Batches, nsamples, idx, rng):
@@ -148,6 +181,7 @@ class FedAvgAPI:
                 ns = jax.lax.with_sharding_constraint(
                     ns, NamedSharding(self.mesh, P("clients"))
                 )
+            cohort, server_state = self._preprocess(cohort, server_state)
             rngs = jax.random.split(rng, idx.shape[0])
             new_stacked, train_metrics = jax.vmap(
                 self._local_train, in_axes=(None, 0, 0)
@@ -157,6 +191,8 @@ class FedAvgAPI:
                 global_params, server_state, new_stacked, weights, cohort, rng
             )
             summed = {k: v.sum() for k, v in train_metrics.items()}
+            if self._keep_stacked:
+                return new_global, new_state, summed, new_stacked
             return new_global, new_state, summed
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
@@ -169,20 +205,16 @@ class FedAvgAPI:
         self._eval_all = jax.jit(eval_all)
         self._eval_global = jax.jit(self._eval)
 
+    def _post_round_stacked(self, stacked: Params, idx: np.ndarray, rng) -> None:
+        """Host-side hook fed the per-client cohort params when
+        ``_keep_stacked`` is set (overridden by S-FedAvg / TurboAggregate)."""
+
     # -- reference-parity sampling ------------------------------------
     def _client_sampling(
         self, round_idx: int, client_num_in_total: int, client_num_per_round: int
     ) -> np.ndarray:
-        """Deterministic per-round sampling
-        (FedAVGAggregator.py:99-113)."""
-        if client_num_in_total == client_num_per_round:
-            return np.arange(client_num_in_total, dtype=np.int32)
-        np.random.seed(round_idx)
-        return np.asarray(
-            np.random.choice(
-                range(client_num_in_total), client_num_per_round, replace=False
-            ),
-            dtype=np.int32,
+        return deterministic_client_sampling(
+            round_idx, client_num_in_total, client_num_per_round
         )
 
     # -- round loop ----------------------------------------------------
@@ -219,7 +251,7 @@ class FedAvgAPI:
                     new_global, summed = self._sequential_round(idx, round_rng)
                     self.global_params = new_global
                 else:
-                    self.global_params, self.server_state, summed = self._round_fn(
+                    out = self._round_fn(
                         self.global_params,
                         self.server_state,
                         packed,
@@ -227,6 +259,9 @@ class FedAvgAPI:
                         jnp.asarray(idx),
                         round_rng,
                     )
+                    self.global_params, self.server_state, summed = out[:3]
+                    if self._keep_stacked:
+                        self._post_round_stacked(out[3], idx, round_rng)
             if round_idx % freq == 0 or round_idx == comm_rounds - 1:
                 with self.profiler.span("eval"):
                     stats = self._local_test_on_all_clients(round_idx)
@@ -384,7 +419,10 @@ class FedNovaAPI(FedAvgAPI):
 
 def _algorithms():
     from .decentralized import DecentralizedDSGDAPI, DecentralizedPushSumAPI
+    from .defenses import HSFedAvgAPI, SFedAvgAPI
+    from .fedgan import FedGANAPI
     from .hierarchical_fl import HierarchicalFLAPI
+    from .turboaggregate import TurboAggregateAPI
 
     return {
         "FedAvg": FedAvgAPI,
@@ -394,6 +432,10 @@ def _algorithms():
         "HierFedAvg": HierarchicalFLAPI,
         "DSGD": DecentralizedDSGDAPI,
         "PushSum": DecentralizedPushSumAPI,
+        "SFedAvg": SFedAvgAPI,
+        "HSFedAvg": HSFedAvgAPI,
+        "FedGAN": FedGANAPI,
+        "TurboAggregate": TurboAggregateAPI,
     }
 
 

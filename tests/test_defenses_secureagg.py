@@ -1,0 +1,207 @@
+"""S-FedAvg / HS-FedAvg defenses, FedGAN, and TurboAggregate secure agg."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import models
+from fedml_tpu.core.secure_agg import (
+    FIELD_PRIME,
+    TurboAggregateProtocol,
+    additive_share,
+    dequantize,
+    lagrange_coeffs,
+    modular_inv,
+    quantize,
+    shamir_reconstruct,
+    shamir_share,
+)
+from fedml_tpu.data import load
+from fedml_tpu.simulation.defenses import HSFedAvgAPI, SFedAvgAPI, make_hs_normalizer
+from fedml_tpu.simulation.fedavg_api import FedAvgAPI
+from fedml_tpu.simulation.fedgan import FedGANAPI
+from fedml_tpu.simulation.turboaggregate import TurboAggregateAPI
+
+
+def _small_args(make, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=400,
+        synthetic_test_size=120,
+        model="lr",
+        partition_method="homo",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=2,
+        epochs=1,
+        batch_size=25,
+        learning_rate=0.1,
+        momentum=0.0,
+        weight_decay=0.0,
+        frequency_of_the_test=1,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+class TestSecureAggPrimitives:
+    def test_modular_inverse(self):
+        rng = np.random.default_rng(0)
+        a = rng.integers(1, FIELD_PRIME, size=(64,), dtype=np.int64)
+        inv = modular_inv(a)
+        assert np.all(np.mod(a * inv, FIELD_PRIME) == 1)
+
+    def test_lagrange_interpolation_recovers_poly(self):
+        # f(x) = 3 + 5x + 7x^2 over the field; interpolate through 3 pts
+        p = FIELD_PRIME
+        f = lambda x: (3 + 5 * x + 7 * x * x) % p
+        beta = [1, 2, 3]
+        alpha = [0, 10]
+        U = lagrange_coeffs(alpha, beta, p)
+        vals = np.array([f(b) for b in beta], dtype=np.int64)
+        got = np.mod(U @ vals, p)
+        assert got[0] == f(0) and got[1] == f(10)
+
+    def test_shamir_share_reconstruct(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, FIELD_PRIME, size=(17,), dtype=np.int64)
+        shares = shamir_share(x, n=5, t=2, rng=rng)
+        # any t+1 = 3 shares reconstruct
+        got = shamir_reconstruct(shares[[0, 2, 4]], points=[1, 3, 5])
+        assert np.array_equal(got, x)
+        got2 = shamir_reconstruct(shares[[1, 2, 3]], points=[2, 3, 4])
+        assert np.array_equal(got2, x)
+
+    def test_additive_shares_sum(self):
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, FIELD_PRIME, size=(33,), dtype=np.int64)
+        sh = additive_share(x, 4, rng)
+        assert np.array_equal(np.mod(sh.sum(axis=0), FIELD_PRIME), x)
+        # individual shares look nothing like x
+        assert not np.array_equal(sh[0], x)
+
+    def test_quantize_roundtrip(self):
+        x = np.array([-1.5, 0.0, 0.25, 3.75, -0.000015])
+        q = quantize(x, 2.0**16)
+        back = dequantize(q, 2.0**16)
+        assert np.allclose(back, x, atol=1.0 / 2**16)
+
+    def test_secure_weighted_sum_matches_plain(self):
+        rng = np.random.default_rng(3)
+        n, dim = 8, 101
+        updates = [rng.normal(size=(dim,)) for _ in range(n)]
+        w = rng.dirichlet(np.ones(n))
+        proto = TurboAggregateProtocol(n_clients=n, n_groups=3, seed=0)
+        got = proto.secure_weighted_sum(updates, w)
+        want = sum(wi * ui for wi, ui in zip(w, updates))
+        assert np.allclose(got, want, atol=n * 1.0 / 2**16)
+
+
+class TestTurboAggregateAPI:
+    def test_matches_fedavg_within_quant_error(self, args_factory):
+        args = _small_args(args_factory, comm_round=1)
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        plain = FedAvgAPI(args, None, dataset, model)
+        plain.train()
+        args2 = _small_args(args_factory, comm_round=1)
+        secure = TurboAggregateAPI(args2, None, dataset, model)
+        secure.train()
+        for a, b in zip(
+            jax.tree.leaves(plain.global_params), jax.tree.leaves(secure.global_params)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+class TestSFedAvg:
+    def test_smoke_and_reputation_update(self, args_factory):
+        args = _small_args(args_factory, comm_round=2, sfedavg_alpha=0.5, sfedavg_beta=0.5)
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        api = SFedAvgAPI(args, None, dataset, model)
+        stats = api.train()
+        assert np.isfinite(stats["test_acc"])
+        assert len(api.sv_history) == 2
+        # phi moved off its uniform init
+        assert np.std(api.phi) > 0
+
+    def test_poisoned_client_scores_lower(self, args_factory):
+        args = _small_args(
+            args_factory,
+            comm_round=3,
+            client_num_in_total=4,
+            client_num_per_round=4,
+            learning_rate=0.3,
+            sfedavg_alpha=0.0,
+            sfedavg_beta=1.0,
+            valid_batches=4,
+        )
+        dataset = load(args)
+        # corrupt client 0: rotate every label
+        y = np.asarray(dataset.packed_train.y)
+        y0 = y.copy()
+        y0[0] = (y0[0] + 1) % dataset.class_num
+        dataset = dataclasses.replace(
+            dataset,
+            packed_train=dataset.packed_train.replace(y=jnp.asarray(y0)),
+        )
+        model = models.create(args, dataset.class_num)
+        api = SFedAvgAPI(args, None, dataset, model)
+        api.train()
+        others = [api.phi[i] for i in range(1, 4)]
+        assert api.phi[0] < np.mean(others)
+
+
+class TestHSFedAvg:
+    def test_normalizer_equalizes_dc_amplitude(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(6, 8, 8, 1)).astype(np.float32) + 2.0)
+        mask = jnp.ones((6,), jnp.float32)
+        norm = make_hs_normalizer(8, 8, L=0.0, momentum=0.1)
+        x2, amp = norm(x, mask, jnp.zeros((8, 8, 1)))
+        # DC amplitude (|sum of pixels|) is now identical across images
+        dc = np.abs(np.asarray(x2).sum(axis=(1, 2, 3)))
+        assert np.allclose(dc, dc[0], rtol=1e-4)
+        # first call seeds the running amplitude from the batch mean
+        fft = np.fft.fft2(np.asarray(x), axes=(1, 2))
+        assert np.allclose(np.asarray(amp), np.abs(fft).mean(axis=0), rtol=1e-4)
+
+    def test_normalizer_leaves_padding_untouched(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 8, 8, 1)).astype(np.float32))
+        mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        norm = make_hs_normalizer(8, 8, L=0.0, momentum=0.1)
+        x2, _ = norm(x, mask, jnp.zeros((8, 8, 1)))
+        np.testing.assert_array_equal(np.asarray(x2[2:]), np.asarray(x[2:]))
+
+    def test_api_trains(self, args_factory):
+        args = _small_args(args_factory, comm_round=2, model="cnn")
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        api = HSFedAvgAPI(args, None, dataset, model)
+        stats = api.train()
+        assert np.isfinite(stats["test_acc"])
+        # running amplitude spectrum is live server state
+        assert float(jnp.abs(api.server_state).sum()) > 0
+
+
+class TestFedGAN:
+    def test_trains_and_reports(self, args_factory):
+        args = _small_args(
+            args_factory,
+            comm_round=2,
+            client_num_in_total=4,
+            client_num_per_round=2,
+            batch_size=16,
+            synthetic_train_size=128,
+            synthetic_test_size=32,
+        )
+        dataset = load(args)
+        api = FedGANAPI(args, None, dataset)
+        stats = api.train()
+        assert np.isfinite(stats["d_loss"]) and np.isfinite(stats["g_loss"])
+        assert 0.0 <= stats["disc_acc"] <= 1.0
+        assert len(api.history) == 2
