@@ -106,6 +106,8 @@ def additive_share(
     x: np.ndarray, n: int, rng: np.random.Generator, p: int = FIELD_PRIME
 ) -> np.ndarray:
     """n additive shares summing to x mod p. Returns [n, *x.shape]."""
+    if n < 1:
+        raise ValueError("additive_share needs at least one recipient")
     x = np.mod(np.asarray(x, dtype=np.int64), p)
     shares = rng.integers(0, p, size=(n - 1,) + x.shape, dtype=np.int64)
     last = np.mod(x - np.mod(shares.sum(axis=0), p), p)
@@ -164,7 +166,9 @@ class TurboAggregateProtocol:
     def __init__(self, n_clients: int, n_groups: int = 4, scale: float = 2.0**16,
                  seed: int = 0, p: int = FIELD_PRIME):
         self.n_clients = n_clients
-        self.n_groups = max(2, min(n_groups, n_clients))
+        # at most one group per client (an empty group would have no
+        # members to receive shares), at least one
+        self.n_groups = max(1, min(n_groups, n_clients))
         self.scale = scale
         self.p = p
         self.rng = np.random.default_rng(seed)

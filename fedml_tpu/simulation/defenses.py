@@ -186,6 +186,15 @@ class SFedAvgAPI(FedAvgAPI):
         )
         logging.debug("S-FedAvg: %d permutations, sv=%s", cnt, sv_est)
 
+    # -- checkpoint hooks: persist the reputation state ---------------
+    def _extra_checkpoint_state(self):
+        return {"phi": self.phi, "sv": self.sv}
+
+    def _restore_extra_state(self, extra) -> None:
+        if extra is not None:
+            self.phi = np.asarray(extra["phi"], dtype=np.float64)
+            self.sv = np.asarray(extra["sv"], dtype=np.float64)
+
     # -- reputation-biased sampling (fedavg_api.py:435-477) -----------
     def _client_sampling(self, round_idx, client_num_in_total, client_num_per_round):
         if client_num_in_total == client_num_per_round:

@@ -302,9 +302,17 @@ class FedAvgAPI:
             )
             self.rng = jnp.asarray(restored["rng"], dtype=jnp.uint32)
             start_round = int(restored["round_idx"]) + 1
+            self._restore_extra_state(restored.get("extra"))
             logging.info("resuming from round %d", start_round)
         self._to_state_dict = to_state_dict
         return ckpt, start_round
+
+    def _extra_checkpoint_state(self):
+        """Algorithm-side host state to persist (S-FedAvg reputation)."""
+        return None
+
+    def _restore_extra_state(self, extra) -> None:
+        pass
 
     def _save_checkpoint(self, ckpt, round_idx: int) -> None:
         state = {
@@ -313,6 +321,9 @@ class FedAvgAPI:
             "rng": self.rng,
             "round_idx": round_idx,
         }
+        extra = self._extra_checkpoint_state()
+        if extra is not None:
+            state["extra"] = extra
         ckpt.save(round_idx, state)
 
     def _sequential_round(self, idx: np.ndarray, rng: jax.Array):
@@ -422,6 +433,7 @@ def _algorithms():
     from .defenses import HSFedAvgAPI, SFedAvgAPI
     from .fedgan import FedGANAPI
     from .hierarchical_fl import HierarchicalFLAPI
+    from .split_learning import FedGKTAPI, SplitNNAPI, VFLAPI
     from .turboaggregate import TurboAggregateAPI
 
     return {
@@ -436,6 +448,9 @@ def _algorithms():
         "HSFedAvg": HSFedAvgAPI,
         "FedGAN": FedGANAPI,
         "TurboAggregate": TurboAggregateAPI,
+        "SplitNN": SplitNNAPI,
+        "FedGKT": FedGKTAPI,
+        "VFL": VFLAPI,
     }
 
 

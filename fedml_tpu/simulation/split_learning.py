@@ -1,0 +1,649 @@
+"""Split / vertical training: SplitNN, FedGKT, classical VFL.
+
+Reference parity (behavior, not implementation):
+
+- **SplitNN** — ``simulation/mpi_p2p_mp/split_nn/`` — the network is cut
+  at a layer: the client owns the bottom, the server the top. Every
+  batch, activations cross the boundary forward
+  (``client.py:25-31 forward_pass``) and activation-gradients cross it
+  backward (``server.py:61-65 backward_pass`` → ``client.py:33-36``).
+  Clients take turns around a ring, relaying the bottom-model weights.
+
+- **FedGKT** — ``simulation/mpi_p2p_mp/fedgkt/`` — Group Knowledge
+  Transfer: each client trains a small extractor+head on raw data
+  (CE + alpha*KL vs the server's logits, ``GKTClientTrainer.py:92-103``),
+  ships extracted features + local logits + labels; the server trains a
+  big net on the features (KL vs client logits + alpha*CE,
+  ``GKTServerTrainer.py:326-340``) and returns per-client server logits.
+  Client models stay personal (never averaged).
+
+- **Classical VFL** — ``simulation/mpi_p2p_mp/classical_vertical_fl/``
+  — features are partitioned vertically across parties; each party runs
+  a bottom net on its slice, the guest combines party outputs, computes
+  the loss against its labels, and returns the boundary gradient to
+  every host (``guest_trainer.py:91-153``).
+
+TPU-first redesign: every boundary crossing is expressed as an explicit
+``jax.vjp`` seam inside ONE jitted computation — activations/gradients
+are device arrays that never visit the host (the reference round-trips
+``.cpu().detach().numpy()`` per batch, guest_trainer.py:109-131). The
+seam is also where a mesh partition would place the stage boundary
+(split-style model parallelism over ICI). FedGKT's cohort trains via
+``vmap`` like the FedAvg engine; the server's big-model training is a
+``lax.scan`` over the concatenated client feature batches.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.types import Batches
+from ..data.loader import FederatedDataset
+
+Params = Any
+
+
+def _masked_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    logp = jax.nn.log_softmax(logits)
+    per = -jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    count = mask.sum()
+    loss = (per * mask).sum() / jnp.maximum(count, 1.0)
+    pred = jnp.argmax(logits, axis=-1)
+    correct = ((pred == y) * mask).sum()
+    return loss, {"correct": correct, "count": count}
+
+
+def _kl_loss(student_logits, teacher_logits, mask, temperature: float):
+    """KL(teacher || student) with temperature scaling, masked mean —
+    ``utils.KL_Loss`` in the reference GKT (T^2-scaled)."""
+    t = temperature
+    p_t = jax.nn.softmax(teacher_logits / t)
+    logp_s = jax.nn.log_softmax(student_logits / t)
+    logp_t = jax.nn.log_softmax(teacher_logits / t)
+    per = (p_t * (logp_t - logp_s)).sum(axis=-1) * (t * t)
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# SplitNN
+# ---------------------------------------------------------------------------
+
+
+class SplitNNAPI:
+    """Ring-relay split learning over a (bottom, top) model pair.
+
+    The split pair is the GKT client/server pair (a GN-ResNet cut at the
+    first stage boundary — ``model/cv/resnet56_gkt`` shape). One bottom
+    model is relayed around the client ring (SplitNN's defining
+    difference from FL: no weight averaging), the server's top model
+    persists across all clients.
+    """
+
+    algorithm = "SplitNN"
+
+    def __init__(self, args, device, dataset: FederatedDataset, model=None, mesh=None):
+        from ..models.gkt import GKTClientNet, GKTServerNet
+
+        self.args = args
+        self.dataset = dataset
+        self.history: List[Dict[str, float]] = []
+        cls = dataset.class_num
+        self.bottom = GKTClientNet(output_dim=cls)
+        self.top = GKTServerNet(
+            output_dim=cls,
+            stage_sizes=tuple(
+                int(s) for s in getattr(args, "splitnn_stages", (1, 1, 1))
+            ),
+        )
+        img_shape = tuple(dataset.packed_train.x.shape[-3:])
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.rng, br, tr = jax.random.split(self.rng, 3)
+        x0 = jnp.zeros((1,) + img_shape)
+        self.bottom_params = self.bottom.init(br, x0)["params"]
+        feats0, _ = self.bottom.apply({"params": self.bottom_params}, x0)
+        self.top_params = self.top.init(tr, feats0)["params"]
+
+        lr = float(getattr(args, "learning_rate", 0.1))
+        mom = float(getattr(args, "momentum", 0.9))
+        self.opt_b = optax.sgd(lr, momentum=mom if mom else None)
+        self.opt_t = optax.sgd(lr, momentum=mom if mom else None)
+        self.opt_b_state = self.opt_b.init(self.bottom_params)
+        self.opt_t_state = self.opt_t.init(self.top_params)
+        self.epochs = int(getattr(args, "epochs", 1))
+        self._build_jitted()
+
+    def _build_jitted(self) -> None:
+        bottom, top = self.bottom, self.top
+        opt_b, opt_t = self.opt_b, self.opt_t
+        epochs = self.epochs
+
+        def step(carry, batch):
+            pb, pt, sb, st = carry
+            x, y, m = batch
+
+            # -- the split boundary: activations forward ---------------
+            def bottom_fwd(p):
+                feats, _ = bottom.apply({"params": p}, x)
+                return feats
+
+            acts, vjp_b = jax.vjp(bottom_fwd, pb)
+
+            # -- server side: loss on top of received activations ------
+            def top_loss(pt_, acts_):
+                logits = top.apply({"params": pt_}, acts_)
+                return _masked_ce(logits, y, m)
+
+            (loss, metrics), (g_top, d_acts) = jax.value_and_grad(
+                top_loss, argnums=(0, 1), has_aux=True
+            )(pt, acts)
+
+            # -- boundary gradient back into the client ----------------
+            (g_bottom,) = vjp_b(d_acts)
+
+            ub, sb_new = opt_b.update(g_bottom, sb, pb)
+            ut, st_new = opt_t.update(g_top, st, pt)
+            pb_new = optax.apply_updates(pb, ub)
+            pt_new = optax.apply_updates(pt, ut)
+            nonempty = m.sum() > 0
+            keep = lambda a, b: jax.tree.map(
+                lambda u, v: jnp.where(nonempty, u, v), a, b
+            )
+            return (
+                keep(pb_new, pb),
+                keep(pt_new, pt),
+                keep(sb_new, sb),
+                keep(st_new, st),
+            ), {"loss_sum": loss * metrics["count"], **metrics}
+
+        def client_pass(pb, pt, sb, st, batches: Batches):
+            def epoch(carry, _):
+                carry, ms = jax.lax.scan(
+                    step, carry, (batches.x, batches.y, batches.mask)
+                )
+                return carry, jax.tree.map(jnp.sum, ms)
+
+            (pb, pt, sb, st), per_epoch = jax.lax.scan(
+                epoch, (pb, pt, sb, st), None, length=epochs
+            )
+            last = jax.tree.map(lambda a: a[-1], per_epoch)
+            return pb, pt, sb, st, last
+
+        self._client_pass = jax.jit(client_pass, donate_argnums=(0, 1, 2, 3))
+
+        def evaluate(pb, pt, test: Batches):
+            def estep(_, batch):
+                x, y, m = batch
+                feats, _ = bottom.apply({"params": pb}, x)
+                logits = top.apply({"params": pt}, feats)
+                loss, metrics = _masked_ce(logits, y, m)
+                return None, {"loss_sum": loss * metrics["count"], **metrics}
+
+            _, out = jax.lax.scan(estep, None, (test.x, test.y, test.mask))
+            return jax.tree.map(jnp.sum, out)
+
+        self._evaluate = jax.jit(evaluate)
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        packed = self.dataset.packed_train
+        freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
+        final: Dict[str, float] = {}
+        for round_idx in range(int(args.comm_round)):
+            t0 = time.perf_counter()
+            train_loss_sum, train_count = 0.0, 0.0
+            # ring order: client r%C starts the relay this round
+            C = self.dataset.client_num
+            order = [(round_idx + k) % C for k in range(C)]
+            for ci in order:
+                client = Batches(
+                    x=packed.x[ci], y=packed.y[ci], mask=packed.mask[ci]
+                )
+                (
+                    self.bottom_params,
+                    self.top_params,
+                    self.opt_b_state,
+                    self.opt_t_state,
+                    ms,
+                ) = self._client_pass(
+                    self.bottom_params,
+                    self.top_params,
+                    self.opt_b_state,
+                    self.opt_t_state,
+                    client,
+                )
+                train_loss_sum += float(ms["loss_sum"])
+                train_count += float(ms["count"])
+            if round_idx % freq == 0 or round_idx == int(args.comm_round) - 1:
+                ev = self._evaluate(
+                    self.bottom_params, self.top_params, self.dataset.test_data_global
+                )
+                stats = {
+                    "round": round_idx,
+                    "round_time_s": time.perf_counter() - t0,
+                    "train_loss": train_loss_sum / max(train_count, 1.0),
+                    "test_acc": float(ev["correct"]) / max(float(ev["count"]), 1.0),
+                    "test_loss": float(ev["loss_sum"]) / max(float(ev["count"]), 1.0),
+                }
+                self.history.append(stats)
+                final = stats
+        return final
+
+
+# ---------------------------------------------------------------------------
+# FedGKT
+# ---------------------------------------------------------------------------
+
+
+class FedGKTAPI:
+    """Group Knowledge Transfer. Personal client nets + one big server
+    net trained on exchanged features/logits (bidirectional KD).
+
+    Args: ``gkt_alpha`` (KD mixing, reference ``args.alpha``),
+    ``gkt_temperature`` (reference ``args.temperature``),
+    ``gkt_server_epochs`` (server epochs per round).
+    """
+
+    algorithm = "FedGKT"
+
+    def __init__(self, args, device, dataset: FederatedDataset, model=None, mesh=None):
+        from ..models.gkt import GKTClientNet, GKTServerNet
+
+        self.args = args
+        self.dataset = dataset
+        self.history: List[Dict[str, float]] = []
+        cls = dataset.class_num
+        self.client_net = GKTClientNet(output_dim=cls)
+        self.server_net = GKTServerNet(
+            output_dim=cls,
+            stage_sizes=tuple(
+                int(s) for s in getattr(args, "gkt_server_stages", (2, 2, 2))
+            ),
+        )
+        self.alpha = float(getattr(args, "gkt_alpha", 1.0))
+        self.temperature = float(getattr(args, "gkt_temperature", 3.0))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self.server_epochs = int(getattr(args, "gkt_server_epochs", 1))
+        lr = float(getattr(args, "learning_rate", 0.03))
+
+        C = dataset.client_num
+        img_shape = tuple(dataset.packed_train.x.shape[-3:])
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.rng, cr, sr = jax.random.split(self.rng, 3)
+        x0 = jnp.zeros((1,) + img_shape)
+        p0 = self.client_net.init(cr, x0)["params"]
+        feats0, _ = self.client_net.apply({"params": p0}, x0)
+        self.server_params = self.server_net.init(sr, feats0)["params"]
+        # personal client models: stacked [C, ...]
+        keys = jax.random.split(cr, C)
+        self.client_params = jax.vmap(
+            lambda k: self.client_net.init(k, x0)["params"]
+        )(keys)
+        # per-client server logits fed back as KD teachers
+        nb, bs = dataset.packed_train.mask.shape[-2:]
+        self.server_logits = jnp.zeros((C, nb, bs, cls))
+
+        self.opt_c = optax.sgd(lr, momentum=0.9)
+        self.opt_s = optax.sgd(lr, momentum=0.9)
+        self.opt_s_state = self.opt_s.init(self.server_params)
+        # personal client optimizers persist across rounds (reference
+        # GKTClientTrainer creates its SGD once in __init__)
+        self.opt_c_states = jax.vmap(self.opt_c.init)(self.client_params)
+        self._build_jitted()
+
+    def _build_jitted(self) -> None:
+        client_net, server_net = self.client_net, self.server_net
+        opt_c, opt_s = self.opt_c, self.opt_s
+        alpha, T = self.alpha, self.temperature
+        epochs, server_epochs = self.epochs, self.server_epochs
+
+        def client_local_train(pc, sc, batches: Batches, teacher, kd_weight):
+            """CE + alpha*KL(teacher=server) (GKTClientTrainer.py:92-103)."""
+
+            def loss_fn(p, x, y, m, t_logits):
+                _, logits = client_net.apply({"params": p}, x)
+                ce, metrics = _masked_ce(logits, y, m)
+                kd = _kl_loss(logits, t_logits, m, T)
+                return ce + alpha * kd_weight * kd, metrics
+
+            def step(carry, batch):
+                p, s = carry
+                x, y, m, t_logits = batch
+                (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    p, x, y, m, t_logits
+                )
+                u, s_new = opt_c.update(grads, s, p)
+                p_new = optax.apply_updates(p, u)
+                nonempty = m.sum() > 0
+                p = jax.tree.map(lambda a, b: jnp.where(nonempty, a, b), p_new, p)
+                s = jax.tree.map(lambda a, b: jnp.where(nonempty, a, b), s_new, s)
+                return (p, s), {"loss_sum": loss * metrics["count"], **metrics}
+
+            def epoch(carry, _):
+                carry, ms = jax.lax.scan(
+                    step, carry, (batches.x, batches.y, batches.mask, teacher)
+                )
+                return carry, jax.tree.map(jnp.sum, ms)
+
+            (pc, sc), per_epoch = jax.lax.scan(epoch, (pc, sc), None, length=epochs)
+            return pc, sc, jax.tree.map(lambda a: a[-1], per_epoch)
+
+        def extract(pc, batches: Batches):
+            """Features + logits for every local sample (what the client
+            ships to the server)."""
+
+            def one(x):
+                return client_net.apply({"params": pc}, x)
+
+            return jax.vmap(one)(batches.x)  # ([nb, bs, h, w, c], [nb, bs, cls])
+
+        def gkt_round(client_params, client_opt_states, server_params, opt_s_state,
+                      server_logits, packed: Batches, kd_weight, rng):
+            # 1) personal client training (vmap cohort; all clients
+            #    participate every round — GKT trains the federation)
+            new_client_params, new_client_opt_states, cm = jax.vmap(
+                client_local_train, in_axes=(0, 0, 0, 0, None)
+            )(client_params, client_opt_states, packed, server_logits, kd_weight)
+
+            # 2) feature/logit exchange
+            feats, client_logits = jax.vmap(extract)(new_client_params, packed)
+
+            # 3) server training on all clients' features:
+            #    KL(client logits) + alpha*CE (GKTServerTrainer.py:326-332)
+            C, nb = packed.mask.shape[0], packed.mask.shape[1]
+            flat = lambda a: a.reshape((C * nb,) + a.shape[2:])
+            sf, sl, sy, sm = flat(feats), flat(client_logits), flat(packed.y), flat(packed.mask)
+
+            def s_loss(ps, f, t_logits, y, m):
+                out = server_net.apply({"params": ps}, f)
+                ce, metrics = _masked_ce(out, y, m)
+                kd = _kl_loss(out, t_logits, m, T)
+                return kd + alpha * ce, metrics
+
+            def s_step(carry, batch):
+                ps, ss = carry
+                f, t_logits, y, m = batch
+                (loss, metrics), grads = jax.value_and_grad(s_loss, has_aux=True)(
+                    ps, f, t_logits, y, m
+                )
+                u, ss_new = opt_s.update(grads, ss, ps)
+                ps_new = optax.apply_updates(ps, u)
+                nonempty = m.sum() > 0
+                ps = jax.tree.map(lambda a, b: jnp.where(nonempty, a, b), ps_new, ps)
+                ss = jax.tree.map(lambda a, b: jnp.where(nonempty, a, b), ss_new, ss)
+                return (ps, ss), {"loss_sum": loss * metrics["count"], **metrics}
+
+            def s_epoch(carry, _):
+                carry, ms = jax.lax.scan(s_step, carry, (sf, sl, sy, sm))
+                return carry, jax.tree.map(jnp.sum, ms)
+
+            (server_params, opt_s_state), s_per_epoch = jax.lax.scan(
+                s_epoch, (server_params, opt_s_state), None, length=server_epochs
+            )
+            s_last = jax.tree.map(lambda a: a[-1], s_per_epoch)
+
+            # 4) refreshed per-client server logits (KD teachers)
+            def s_logits(f):
+                return server_net.apply({"params": server_params}, f)
+
+            new_server_logits = jax.vmap(jax.vmap(s_logits))(feats)
+            client_summed = jax.tree.map(lambda a: a.sum(), cm)
+            return (
+                new_client_params,
+                new_client_opt_states,
+                server_params,
+                opt_s_state,
+                new_server_logits,
+                {"client": client_summed, "server": s_last},
+            )
+
+        self._round_fn = jax.jit(gkt_round, donate_argnums=(0, 1, 2, 3, 4))
+
+        def evaluate(client_params, server_params, packed_test: Batches):
+            """Per-client extractor -> server net on local test sets
+            (the reference's server-side test over client-sent test
+            features, GKTServerTrainer.py:371-403)."""
+
+            def per_client(pc, batches):
+                def estep(_, batch):
+                    x, y, m = batch
+                    f, _ = client_net.apply({"params": pc}, x)
+                    out = server_net.apply({"params": server_params}, f)
+                    loss, metrics = _masked_ce(out, y, m)
+                    return None, {"loss_sum": loss * metrics["count"], **metrics}
+
+                _, out = jax.lax.scan(estep, None, (batches.x, batches.y, batches.mask))
+                return jax.tree.map(jnp.sum, out)
+
+            sums = jax.vmap(per_client)(client_params, packed_test)
+            return jax.tree.map(lambda a: a.sum(), sums)
+
+        self._evaluate = jax.jit(evaluate)
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        packed = self.dataset.packed_train
+        freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
+        final: Dict[str, float] = {}
+        for round_idx in range(int(args.comm_round)):
+            t0 = time.perf_counter()
+            self.rng, r_rng = jax.random.split(self.rng)
+            kd_weight = jnp.asarray(0.0 if round_idx == 0 else 1.0)
+            (
+                self.client_params,
+                self.opt_c_states,
+                self.server_params,
+                self.opt_s_state,
+                self.server_logits,
+                ms,
+            ) = self._round_fn(
+                self.client_params,
+                self.opt_c_states,
+                self.server_params,
+                self.opt_s_state,
+                self.server_logits,
+                packed,
+                kd_weight,
+                r_rng,
+            )
+            if round_idx % freq == 0 or round_idx == int(args.comm_round) - 1:
+                ev = self._evaluate(
+                    self.client_params, self.server_params, self.dataset.packed_test
+                )
+                stats = {
+                    "round": round_idx,
+                    "round_time_s": time.perf_counter() - t0,
+                    "train_loss": float(ms["client"]["loss_sum"])
+                    / max(float(ms["client"]["count"]), 1.0),
+                    "server_loss": float(ms["server"]["loss_sum"])
+                    / max(float(ms["server"]["count"]), 1.0),
+                    "test_acc": float(ev["correct"]) / max(float(ev["count"]), 1.0),
+                    "test_loss": float(ev["loss_sum"]) / max(float(ev["count"]), 1.0),
+                }
+                self.history.append(stats)
+                final = stats
+        return final
+
+
+# ---------------------------------------------------------------------------
+# Classical VFL
+# ---------------------------------------------------------------------------
+
+
+def vertical_split(x: np.ndarray, n_parties: int) -> List[np.ndarray]:
+    """Partition flattened features column-wise across parties
+    (NUS-WIDE / lending-club style feature split)."""
+    flat = x.reshape(x.shape[0], -1)
+    cols = np.array_split(np.arange(flat.shape[1]), n_parties)
+    return [flat[:, c] for c in cols]
+
+
+class VFLAPI:
+    """Classical vertical FL: guest + (n_parties-1) hosts.
+
+    Every party runs a bottom net on its private feature slice; the
+    guest sums the party representations, applies its top model and the
+    loss, and the boundary gradient (identical for every party, since
+    the combiner is a sum) flows back through each party's ``vjp``
+    (guest_trainer.py:91-153's numpy round-trip, fused on-device).
+    """
+
+    algorithm = "VFL"
+
+    def __init__(self, args, device, dataset: FederatedDataset, model=None, mesh=None):
+        from ..models.vfl import GuestTopModel, PartyLocalModel
+
+        self.args = args
+        self.dataset = dataset
+        self.history: List[Dict[str, float]] = []
+        self.n_parties = int(getattr(args, "vfl_parties", 2))
+        rep_dim = int(getattr(args, "vfl_rep_dim", 32))
+        cls = dataset.class_num
+        lr = float(getattr(args, "learning_rate", 0.05))
+        self.epochs = int(getattr(args, "epochs", 1))
+
+        # vertically partition the centralized training features
+        tr, te = dataset.train_data_global, dataset.test_data_global
+        self._train = self._split_batches(tr)
+        self._test = self._split_batches(te)
+
+        self.party_net = PartyLocalModel(output_dim=rep_dim)
+        self.top_net = GuestTopModel(output_dim=cls)
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        keys = jax.random.split(self.rng, self.n_parties + 1)
+        self.party_params = [
+            self.party_net.init(keys[i], jnp.zeros((1, self._train[0][i].shape[-1])))[
+                "params"
+            ]
+            for i in range(self.n_parties)
+        ]
+        self.top_params = self.top_net.init(keys[-1], jnp.zeros((1, rep_dim)))["params"]
+        self.opt = optax.sgd(lr)
+        self.opt_states = [self.opt.init(p) for p in self.party_params]
+        self.opt_top_state = self.opt.init(self.top_params)
+        self._build_jitted()
+
+    def _split_batches(self, b: Batches):
+        """[nb, bs, ...] -> (party feature slices [nb, bs, d_k], y, mask)."""
+        x = np.asarray(b.x)
+        nb, bs = x.shape[0], x.shape[1]
+        slices = vertical_split(x.reshape(nb * bs, -1), self.n_parties)
+        return (
+            [jnp.asarray(s.reshape(nb, bs, -1)) for s in slices],
+            b.y,
+            b.mask,
+        )
+
+    def _build_jitted(self) -> None:
+        party_net, top_net, opt = self.party_net, self.top_net, self.opt
+        n_parties, epochs = self.n_parties, self.epochs
+
+        def step(carry, batch):
+            party_params, top_params, opt_states, opt_top = carry
+            xs, y, m = batch[:-2], batch[-2], batch[-1]
+
+            # party bottoms: forward with a vjp seam each
+            reps, vjps = [], []
+            for k in range(n_parties):
+                rep, vjp_k = jax.vjp(
+                    lambda p, xk=xs[k]: party_net.apply({"params": p}, xk),
+                    party_params[k],
+                )
+                reps.append(rep)
+                vjps.append(vjp_k)
+            rep_sum = sum(reps)
+
+            def guest_loss(pt, rep):
+                logits = top_net.apply({"params": pt}, rep)
+                return _masked_ce(logits, y, m)
+
+            (loss, metrics), (g_top, d_rep) = jax.value_and_grad(
+                guest_loss, argnums=(0, 1), has_aux=True
+            )(top_params, rep_sum)
+
+            new_party, new_states = [], []
+            for k in range(n_parties):
+                (g_k,) = vjps[k](d_rep)  # same boundary grad to every host
+                u, s_new = opt.update(g_k, opt_states[k], party_params[k])
+                new_party.append(optax.apply_updates(party_params[k], u))
+                new_states.append(s_new)
+            u_t, opt_top_new = opt.update(g_top, opt_top, top_params)
+            top_new = optax.apply_updates(top_params, u_t)
+
+            nonempty = m.sum() > 0
+            keep = lambda a, b: jax.tree.map(
+                lambda u_, v_: jnp.where(nonempty, u_, v_), a, b
+            )
+            return (
+                [keep(a, b) for a, b in zip(new_party, party_params)],
+                keep(top_new, top_params),
+                [keep(a, b) for a, b in zip(new_states, opt_states)],
+                keep(opt_top_new, opt_top),
+            ), {"loss_sum": loss * metrics["count"], **metrics}
+
+        def run_epochs(party_params, top_params, opt_states, opt_top, xs, y, m):
+            def epoch(carry, _):
+                carry, ms = jax.lax.scan(step, carry, tuple(xs) + (y, m))
+                return carry, jax.tree.map(jnp.sum, ms)
+
+            carry, per_epoch = jax.lax.scan(
+                epoch, (party_params, top_params, opt_states, opt_top), None,
+                length=epochs,
+            )
+            return carry, jax.tree.map(lambda a: a[-1], per_epoch)
+
+        self._run_epochs = jax.jit(run_epochs)
+
+        def evaluate(party_params, top_params, xs, y, m):
+            def estep(_, batch):
+                bxs, by, bm = batch[:-2], batch[-2], batch[-1]
+                rep = sum(
+                    party_net.apply({"params": party_params[k]}, bxs[k])
+                    for k in range(n_parties)
+                )
+                logits = top_net.apply({"params": top_params}, rep)
+                loss, metrics = _masked_ce(logits, by, bm)
+                return None, {"loss_sum": loss * metrics["count"], **metrics}
+
+            _, out = jax.lax.scan(estep, None, tuple(xs) + (y, m))
+            return jax.tree.map(lambda a: a.sum(), out)
+
+        self._eval = jax.jit(evaluate)
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        xs, y, m = self._train
+        freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
+        final: Dict[str, float] = {}
+        for round_idx in range(int(args.comm_round)):
+            t0 = time.perf_counter()
+            (
+                (self.party_params, self.top_params, self.opt_states, self.opt_top_state),
+                ms,
+            ) = self._run_epochs(
+                self.party_params,
+                self.top_params,
+                self.opt_states,
+                self.opt_top_state,
+                xs,
+                y,
+                m,
+            )
+            if round_idx % freq == 0 or round_idx == int(args.comm_round) - 1:
+                txs, ty, tm = self._test
+                ev = self._eval(self.party_params, self.top_params, txs, ty, tm)
+                stats = {
+                    "round": round_idx,
+                    "round_time_s": time.perf_counter() - t0,
+                    "train_loss": float(ms["loss_sum"]) / max(float(ms["count"]), 1.0),
+                    "test_acc": float(ev["correct"]) / max(float(ev["count"]), 1.0),
+                    "test_loss": float(ev["loss_sum"]) / max(float(ev["count"]), 1.0),
+                }
+                self.history.append(stats)
+                final = stats
+        return final

@@ -42,6 +42,12 @@ class TurboAggregateAPI(FedAvgAPI):
     _keep_stacked = True
 
     def __init__(self, args, device, dataset, model, mesh=None) -> None:
+        if getattr(args, "defense_type", None):
+            raise ValueError(
+                "TurboAggregate replaces the aggregation step with the "
+                "secure-sum protocol; robust defense_type cannot be "
+                "combined with it (the server never sees raw updates)"
+            )
         super().__init__(args, device, dataset, model, mesh=mesh)
         self.protocol = TurboAggregateProtocol(
             n_clients=int(args.client_num_per_round),
@@ -62,11 +68,15 @@ class TurboAggregateAPI(FedAvgAPI):
         ns = np.take(np.asarray(self.dataset.packed_num_samples), np.asarray(idx))
         weights = np.asarray(normalize_weights(jnp.asarray(ns)))
         C = int(idx.shape[0])
-        updates, spec = [], None
-        for j in range(C):
-            client_params = jax.tree.map(lambda a: a[j], stacked)
-            flat, spec = flatten_params(client_params)
-            updates.append(flat)
+        # one device->host transfer for the whole cohort, then numpy
+        # slicing per client
+        stacked_host = jax.device_get(stacked)
+        leaves = jax.tree.leaves(stacked_host)
+        updates = [
+            np.concatenate([np.asarray(l[j]).reshape(-1) for l in leaves])
+            for j in range(C)
+        ]
+        _, spec = flatten_params(jax.tree.map(lambda a: a[0], stacked_host))
         agg = self.protocol.secure_weighted_sum(updates, weights.astype(np.float64))
         self.global_params = jax.tree.map(
             jnp.asarray, unflatten_params(agg, spec)

@@ -99,6 +99,12 @@ class TestSecureAggPrimitives:
         want = sum(wi * ui for wi, ui in zip(w, updates))
         assert np.allclose(got, want, atol=n * 1.0 / 2**16)
 
+    def test_single_client_protocol(self):
+        proto = TurboAggregateProtocol(n_clients=1, n_groups=4, seed=0)
+        x = np.array([1.5, -2.0, 0.0])
+        got = proto.secure_weighted_sum([x], np.array([1.0]))
+        assert np.allclose(got, x, atol=1e-4)
+
 
 class TestTurboAggregateAPI:
     def test_matches_fedavg_within_quant_error(self, args_factory):
@@ -153,6 +159,21 @@ class TestSFedAvg:
         api.train()
         others = [api.phi[i] for i in range(1, 4)]
         assert api.phi[0] < np.mean(others)
+
+    def test_reputation_survives_resume(self, args_factory, tmp_path):
+        kw = dict(comm_round=2, checkpoint_freq=1, checkpoint_dir=str(tmp_path / "ck"))
+        args = _small_args(args_factory, **kw)
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        api = SFedAvgAPI(args, None, dataset, model)
+        api.train()
+        phi_after = api.phi.copy()
+        # a fresh API restores reputation from the checkpoint
+        api2 = SFedAvgAPI(_small_args(args_factory, **kw), None, dataset, model)
+        ckpt, start = api2._maybe_restore()
+        ckpt.close()
+        assert start == 2
+        np.testing.assert_allclose(api2.phi, phi_after)
 
 
 class TestHSFedAvg:
