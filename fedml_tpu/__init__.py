@@ -111,3 +111,20 @@ def run_cross_silo_client(args: Optional[Arguments] = None):
     model = models.create(args, dataset.class_num)
     client = Client(args, dev, dataset, model)
     return client.run()
+
+
+def run_edge_server(args: Optional[Arguments] = None):
+    """One-line cross-device server — the ``run_mnn_server`` analog
+    (__init__.py:256-274): edge clients ship model files over the
+    pub/sub data plane; the server aggregates on TPU."""
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE
+    from . import data, device, models
+    from .cross_device import ServerEdge
+
+    args = init(args)
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    server = ServerEdge(args, dev, dataset, model)
+    return server.run()

@@ -25,6 +25,7 @@ FEDML_CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
 COMM_BACKEND_LOCAL = "LOCAL"  # in-process queues (tests / single host)
 COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_MPI = "MPI"  # accepted; mapped onto the LOCAL/GRPC transports
+COMM_BACKEND_MQTT = "MQTT"
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"
 COMM_BACKEND_SP = "sp"
 COMM_BACKEND_MESH = "MESH"
@@ -57,6 +58,7 @@ MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
 MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
 MSG_ARG_KEY_CLIENT_STATUS = "client_status"
 MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+MSG_ARG_KEY_MODEL_FILE_URL = "model_file_url"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_STATUS_IDLE = "IDLE"

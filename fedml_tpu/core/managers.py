@@ -39,6 +39,25 @@ def _build_com_manager(
             ip_config=ip_config,
             port_base=int(getattr(args, "grpc_port_base", 8890)),
         )
+    if backend in (constants.COMM_BACKEND_MQTT, constants.COMM_BACKEND_MQTT_S3):
+        from .comm.broker import broker_for_run, ensure_broker
+        from .comm.mqtt_backend import MqttCommunicationManager
+
+        run_id = str(getattr(args, "run_id", "0"))
+        port = int(getattr(args, "broker_port", 0))
+        if port:
+            host, port = ensure_broker(getattr(args, "broker_host", "127.0.0.1"), port)
+        else:
+            host, port = broker_for_run(run_id)
+        control = MqttCommunicationManager(
+            rank=rank, size=size, broker_host=host, broker_port=port, run_id=run_id
+        )
+        if backend == constants.COMM_BACKEND_MQTT:
+            return control
+        from .comm.payload_store import FilePayloadStore, HybridCommunicationManager
+
+        store = FilePayloadStore(getattr(args, "payload_store_dir", None))
+        return HybridCommunicationManager(control, store)
     raise ValueError(f"unsupported comm backend {backend!r}")
 
 

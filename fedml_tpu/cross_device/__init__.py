@@ -1,0 +1,25 @@
+"""Cross-device scenario ("Beehive" parity, SURVEY.md §2.11).
+
+Server-side round loop over a file-shipping protocol with non-JAX edge
+clients; the model-file boundary replaces the reference's .mnn round
+trip. See ``server.py`` / ``client_sim.py`` / ``model_file.py``.
+"""
+
+from .client_sim import EdgeClientSim  # noqa: F401
+from .model_file import (  # noqa: F401
+    model_bytes_to_params,
+    params_to_model_bytes,
+    read_model_file,
+    write_model_file,
+)
+from .server import (  # noqa: F401
+    CrossDeviceAggregator,
+    CrossDeviceServerManager,
+    ServerEdge,
+)
+
+
+def fedavg_cross_device(args, device, dataset, model) -> "ServerEdge":
+    """``server_mnn_api.fedavg_cross_device`` analog: build and return
+    the edge server (caller invokes ``.run()``)."""
+    return ServerEdge(args, device, dataset, model)

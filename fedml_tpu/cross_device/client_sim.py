@@ -1,0 +1,97 @@
+"""Simulated edge device for the cross-device protocol.
+
+The reference's cross-device clients are Android apps driven over MQTT
+(tested with canned protocol messages against a physical device,
+``test/android_protocol_test/test_protocol.py:8-40``). This simulator
+is a live stand-in: it speaks the exact server protocol — announce
+ONLINE, download the model FILE, train locally, upload a model file +
+sample count — so the whole Beehive round loop is testable single-host
+(SURVEY.md §4's "every scenario runnable single-host" rule).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants
+from ..core.comm.payload_store import PayloadStore
+from ..core.managers import ClientManager
+from ..core.message import Message
+from .model_file import model_bytes_to_params, params_to_model_bytes
+
+
+class EdgeClientSim(ClientManager):
+    def __init__(self, args, trainer, local_data, store: PayloadStore,
+                 comm=None, rank=0, size=0,
+                 backend=constants.COMM_BACKEND_MQTT) -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer  # jitted local_train(params, batches, rng)
+        self.local_data = local_data  # Batches
+        self.store = store
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + rank)
+        self.num_samples = float(jnp.asarray(local_data.mask).sum())
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_INIT_CONFIG, self.handle_sync_model
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_sync_model
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_FINISH, self.handle_finish
+        )
+
+    def handle_connection_ready(self, msg: Message) -> None:
+        """Announce ONLINE, re-announcing until the server responds —
+        a pub/sub broker drops messages published before the server
+        subscribes (no retained-message analog), so a one-shot
+        announcement can deadlock the presence handshake."""
+        import threading
+
+        self._synced = getattr(self, "_synced", threading.Event())
+
+        def send_online() -> None:
+            status = Message(constants.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+            status.add_params(
+                constants.MSG_ARG_KEY_CLIENT_STATUS, constants.CLIENT_STATUS_ONLINE
+            )
+            self.send_message(status)
+
+        def announce() -> None:
+            while not self._synced.wait(0.5):
+                try:
+                    send_online()
+                except Exception:
+                    logging.exception("edge client %d: announce failed", self.rank)
+                    return
+
+        send_online()
+        threading.Thread(target=announce, daemon=True).start()
+
+    def handle_sync_model(self, msg: Message) -> None:
+        if hasattr(self, "_synced"):
+            self._synced.set()
+        url = msg.get(constants.MSG_ARG_KEY_MODEL_FILE_URL)
+        params = jax.tree.map(
+            jnp.asarray, model_bytes_to_params(self.store.get(url))
+        )
+        self.rng, train_rng = jax.random.split(self.rng)
+        new_params, _ = self.trainer(params, self.local_data, train_rng)
+        out_url = self.store.put(params_to_model_bytes(new_params))
+        reply = Message(constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        reply.add_params(constants.MSG_ARG_KEY_MODEL_FILE_URL, out_url)
+        reply.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, self.num_samples)
+        self.send_message(reply)
+
+    def handle_finish(self, msg: Message) -> None:
+        if hasattr(self, "_synced"):
+            self._synced.set()
+        logging.info("edge client %d: finish", self.rank)
+        self.finish()

@@ -1,0 +1,69 @@
+"""Portable model-file round trip (the .mnn boundary analog).
+
+Reference: cross-device servers exchange **model files** with edge
+clients, not pickled state dicts — ``server_mnn/utils.py:11-51``
+(``read_mnn_as_tensor_dict`` / ``write_tensor_dict_to_mnn``) converts
+.mnn flatbuffers to tensors around the weighted average, and the
+MQTT_S3_MNN backend ships files (``mqtt_s3_mnn/remote_storage.py:56-97``).
+
+The TPU build's edge clients are non-JAX (Android/C++/MNN/TFLite), so
+the boundary is a framework-neutral container: ``.npz`` with
+slash-joined tree paths as keys. Any runtime that can read npz (or the
+C++ runtime's loader) can consume it; round-tripping through this file
+is lossless for pytrees of arrays.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+_SEP = "/"
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    flat: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            flat.update(_flatten(v, key))
+    else:
+        flat[prefix] = np.asarray(tree)
+    return flat
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split(_SEP)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def params_to_model_bytes(params: Any) -> bytes:
+    """Serialize a (nested-dict) param pytree to npz bytes."""
+    host = jax.tree.map(np.asarray, params)
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten(host))
+    return buf.getvalue()
+
+
+def model_bytes_to_params(data: bytes) -> Any:
+    with np.load(io.BytesIO(data)) as z:
+        return _unflatten({k: z[k] for k in z.files})
+
+
+def write_model_file(params: Any, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(params_to_model_bytes(params))
+
+
+def read_model_file(path: str) -> Any:
+    with open(path, "rb") as f:
+        return model_bytes_to_params(f.read())

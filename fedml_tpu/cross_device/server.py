@@ -1,0 +1,181 @@
+"""Cross-device scenario ("Beehive" parity) — server side.
+
+Reference: ``cross_device/mnn_server.py:6-28`` → ``server_mnn/
+server_mnn_api.py:10-66`` → ``server_mnn/fedml_server_manager.py`` +
+``server_mnn/fedml_aggregator.py:15-120``. Edge clients (Android/MNN in
+the reference; any npz-capable runtime here) upload MODEL FILES through
+the data plane; the server converts file ↔ tensors around a weighted
+average (``server_mnn/utils.py:11-51``) and redistributes a file URL.
+
+TPU-first: the aggregation itself is the same jitted stacked weighted
+average the simulator uses — the file boundary only touches the edges.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..core.aggregation import normalize_weights, stack_pytrees, weighted_average
+from ..core.comm.payload_store import FilePayloadStore, PayloadStore
+from ..core.managers import ServerManager
+from ..core.message import Message
+from .model_file import model_bytes_to_params, params_to_model_bytes
+
+
+class CrossDeviceAggregator:
+    """File-boundary aggregator (``server_mnn/fedml_aggregator.py``)."""
+
+    def __init__(self, args, global_params, store: PayloadStore, model=None,
+                 test_data=None) -> None:
+        self.args = args
+        self.store = store
+        self.model = model
+        self.test_data = test_data
+        self.global_params = global_params
+        self.client_num = int(args.client_num_per_round)
+        self._results: Dict[int, str] = {}
+        self._sample_nums: Dict[int, float] = {}
+        self.history: List[Dict[str, float]] = []
+        self._agg = jax.jit(
+            lambda stacked, w: weighted_average(stacked, w)
+        )
+        self._eval = None
+        if model is not None and test_data is not None:
+            from ..core.local_trainer import make_eval_fn
+
+            self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+
+    # -- round bookkeeping (fedml_aggregator.py:40-70) ----------------
+    def add_local_trained_result(self, index: int, model_file_url: str,
+                                 sample_num: float) -> None:
+        self._results[index] = model_file_url
+        self._sample_nums[index] = float(sample_num)
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self._results) >= self.client_num
+
+    def get_global_model_file_url(self) -> str:
+        return self.store.put(params_to_model_bytes(self.global_params))
+
+    def aggregate(self) -> None:
+        """Download files -> tensors -> jitted weighted average -> new
+        global model (fedml_aggregator.py:~70 + utils.py:11-51)."""
+        idxs = sorted(self._results)
+        trees = [
+            jax.tree.map(jnp.asarray,
+                         model_bytes_to_params(self.store.get(self._results[i])))
+            for i in idxs
+        ]
+        ns = jnp.asarray([self._sample_nums[i] for i in idxs])
+        stacked = stack_pytrees(trees)
+        self.global_params = self._agg(stacked, normalize_weights(ns))
+        self._results.clear()
+        self._sample_nums.clear()
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> None:
+        if self._eval is None or self.test_data is None:
+            return
+        sums = self._eval(self.global_params, self.test_data)
+        stats = self.model.metrics_from_sums(jax.tree.map(np.asarray, sums))
+        stats["round"] = round_idx
+        self.history.append(stats)
+        logging.info("cross-device round %d: %s", round_idx, stats)
+
+
+class CrossDeviceServerManager(ServerManager):
+    """Round loop over the file-shipping protocol
+    (``server_mnn/fedml_server_manager.py:15+``)."""
+
+    def __init__(self, args, aggregator: CrossDeviceAggregator, comm=None,
+                 rank=0, size=0, backend=constants.COMM_BACKEND_MQTT) -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.round_idx = 0
+        self.client_ranks = list(range(1, size))
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_CLIENT_STATUS,
+            self.handle_message_client_status,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client,
+        )
+
+    def handle_message_client_status(self, msg: Message) -> None:
+        if msg.get(constants.MSG_ARG_KEY_CLIENT_STATUS) == constants.CLIENT_STATUS_ONLINE:
+            self.client_online_status[msg.get_sender_id()] = True
+        if (
+            all(self.client_online_status.get(r, False) for r in self.client_ranks)
+            and not self.is_initialized
+        ):
+            self.is_initialized = True
+            self._broadcast_model_file(constants.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _broadcast_model_file(self, msg_type: int) -> None:
+        url = self.aggregator.get_global_model_file_url()
+        for rank in self.client_ranks:
+            msg = Message(msg_type, self.rank, rank)
+            msg.add_params(constants.MSG_ARG_KEY_MODEL_FILE_URL, url)
+            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            # device-side dataset assignment (client_real_ids analog)
+            msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
+            self.send_message(msg)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        self.aggregator.add_local_trained_result(
+            msg.get_sender_id(),
+            msg.get(constants.MSG_ARG_KEY_MODEL_FILE_URL),
+            msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES),
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        self.aggregator.aggregate()
+        self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            for rank in self.client_ranks:
+                self.send_message(
+                    Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
+                )
+            logging.info("cross-device server: finished %d rounds", self.round_idx)
+            self.finish()
+            return
+        self._broadcast_model_file(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+
+class ServerEdge:
+    """One-line facade (``ServerMNN``, cross_device/mnn_server.py:6-28)."""
+
+    def __init__(self, args, device, dataset, model, store: Optional[PayloadStore] = None):
+        self.args = args
+        store = store or FilePayloadStore(getattr(args, "payload_store_dir", None))
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        global_params = model.init(rng)
+        size = int(getattr(args, "client_num_per_round", 0)) + 1
+        self.aggregator = CrossDeviceAggregator(
+            args, global_params, store, model=model,
+            test_data=dataset.test_data_global if dataset is not None else None,
+        )
+        self.manager = CrossDeviceServerManager(
+            args,
+            self.aggregator,
+            rank=0,
+            size=size,
+            backend=getattr(
+                args, "cross_device_backend", constants.COMM_BACKEND_MQTT
+            ),
+        )
+
+    def run(self) -> None:
+        self.manager.run()

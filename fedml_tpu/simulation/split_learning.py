@@ -343,7 +343,7 @@ class FedGKTAPI:
             return jax.vmap(one)(batches.x)  # ([nb, bs, h, w, c], [nb, bs, cls])
 
         def gkt_round(client_params, client_opt_states, server_params, opt_s_state,
-                      server_logits, packed: Batches, kd_weight, rng):
+                      server_logits, packed: Batches, kd_weight):
             # 1) personal client training (vmap cohort; all clients
             #    participate every round — GKT trains the federation)
             new_client_params, new_client_opt_states, cm = jax.vmap(
@@ -432,7 +432,6 @@ class FedGKTAPI:
         final: Dict[str, float] = {}
         for round_idx in range(int(args.comm_round)):
             t0 = time.perf_counter()
-            self.rng, r_rng = jax.random.split(self.rng)
             kd_weight = jnp.asarray(0.0 if round_idx == 0 else 1.0)
             (
                 self.client_params,
@@ -449,7 +448,6 @@ class FedGKTAPI:
                 self.server_logits,
                 packed,
                 kd_weight,
-                r_rng,
             )
             if round_idx % freq == 0 or round_idx == int(args.comm_round) - 1:
                 ev = self._evaluate(

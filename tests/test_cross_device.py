@@ -1,0 +1,211 @@
+"""Broker, MQTT/MQTT+S3 backends, model-file boundary, cross-device loop."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import constants, models
+from fedml_tpu.core.comm.broker import Broker, BrokerClient, broker_for_run
+from fedml_tpu.core.comm.mqtt_backend import MqttCommunicationManager
+from fedml_tpu.core.comm.payload_store import (
+    FilePayloadStore,
+    HybridCommunicationManager,
+    params_from_bytes,
+    params_to_bytes,
+)
+from fedml_tpu.core.message import Message
+from fedml_tpu.cross_device import (
+    EdgeClientSim,
+    ServerEdge,
+    model_bytes_to_params,
+    params_to_model_bytes,
+)
+from fedml_tpu.data import load
+
+
+class TestBroker:
+    def test_pub_sub_roundtrip(self):
+        broker = Broker()
+        got = []
+        done = threading.Event()
+        a = BrokerClient(broker.host, broker.port)
+        b = BrokerClient(broker.host, broker.port)
+        a.subscribe("topic/x", lambda t, p: (got.append((t, p)), done.set()))
+        time.sleep(0.05)
+        b.publish("topic/x", b"hello")
+        assert done.wait(5)
+        assert got == [("topic/x", b"hello")]
+        a.close(), b.close(), broker.stop()
+
+    def test_no_cross_topic_leak(self):
+        broker = Broker()
+        got = []
+        done = threading.Event()
+        a = BrokerClient(broker.host, broker.port)
+        a.subscribe("t1", lambda t, p: got.append(p))
+        a.subscribe("t2", lambda t, p: (got.append(p), done.set()))
+        time.sleep(0.05)
+        b = BrokerClient(broker.host, broker.port)
+        b.publish("t3", b"nope")
+        b.publish("t2", b"yes")
+        assert done.wait(5)
+        assert got == [b"yes"]
+        a.close(), b.close(), broker.stop()
+
+
+class TestPayloadStore:
+    def test_roundtrip(self, tmp_path):
+        store = FilePayloadStore(str(tmp_path))
+        url = store.put(b"payload-bytes")
+        assert url.startswith("file://")
+        assert store.get(url) == b"payload-bytes"
+
+    def test_params_bytes_roundtrip(self):
+        tree = {"a": {"w": np.ones((3, 2), np.float32)}, "b": np.arange(4)}
+        back = params_from_bytes(params_to_bytes(tree))
+        np.testing.assert_array_equal(back["a"]["w"], tree["a"]["w"])
+        np.testing.assert_array_equal(back["b"], tree["b"])
+
+
+class TestModelFile:
+    def test_npz_roundtrip_nested(self):
+        params = {
+            "Dense_0": {"kernel": np.random.randn(4, 3).astype(np.float32),
+                        "bias": np.zeros(3, np.float32)},
+            "Block": {"Conv_0": {"kernel": np.ones((3, 3, 1, 8), np.float32)}},
+        }
+        back = model_bytes_to_params(params_to_model_bytes(params))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(a, b)
+        assert jax.tree.structure(params) == jax.tree.structure(back)
+
+
+def _mqtt_pair(run_id, backend_cls=MqttCommunicationManager, wrap=None):
+    host, port = broker_for_run(run_id)
+    m0 = backend_cls(rank=0, size=2, broker_host=host, broker_port=port, run_id=run_id)
+    m1 = backend_cls(rank=1, size=2, broker_host=host, broker_port=port, run_id=run_id)
+    if wrap:
+        m0, m1 = wrap(m0), wrap(m1)
+    return m0, m1
+
+
+class _Capture:
+    def __init__(self):
+        self.messages = []
+        self.event = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.messages.append((msg_type, msg))
+        self.event.set()
+
+
+class TestMqttBackend:
+    def test_message_delivery(self):
+        m0, m1 = _mqtt_pair("t_mqtt_1")
+        cap = _Capture()
+        m1.add_observer(cap)
+        t = threading.Thread(target=m1.handle_receive_message, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        msg = Message(constants.MSG_TYPE_S2C_INIT_CONFIG, 0, 1)
+        msg.add_params("k", np.arange(3))
+        m0.send_message(msg)
+        assert cap.event.wait(5)
+        mt, got = cap.messages[0]
+        assert mt == constants.MSG_TYPE_S2C_INIT_CONFIG
+        np.testing.assert_array_equal(got.get("k"), np.arange(3))
+        m1.stop_receive_message()
+        t.join(5)
+
+    def test_hybrid_swaps_payload_through_store(self, tmp_path):
+        store = FilePayloadStore(str(tmp_path))
+        m0, m1 = _mqtt_pair(
+            "t_mqtt_2", wrap=lambda m: HybridCommunicationManager(m, store)
+        )
+        cap = _Capture()
+        m1.add_observer(cap)
+        t = threading.Thread(target=m1.handle_receive_message, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        params = {"w": np.random.randn(64, 8).astype(np.float32)}
+        msg = Message(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, params)
+        m0.send_message(msg)
+        assert cap.event.wait(5)
+        _, got = cap.messages[0]
+        np.testing.assert_array_equal(
+            got.get(constants.MSG_ARG_KEY_MODEL_PARAMS)["w"], params["w"]
+        )
+        # the control plane never carried the raw tensor
+        assert got.get(constants.MSG_ARG_KEY_MODEL_PARAMS + "_url") is None
+        m1.stop_receive_message()
+        t.join(5)
+
+
+class TestCrossDeviceRound:
+    def test_full_beehive_loop(self, args_factory, tmp_path):
+        n_clients = 3
+        args = args_factory(
+            dataset="mnist",
+            synthetic_train_size=300,
+            synthetic_test_size=60,
+            model="lr",
+            client_num_in_total=n_clients,
+            client_num_per_round=n_clients,
+            comm_round=2,
+            epochs=1,
+            batch_size=25,
+            learning_rate=0.1,
+            run_id="beehive_test",
+            payload_store_dir=str(tmp_path),
+        )
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        store = FilePayloadStore(str(tmp_path))
+        server = ServerEdge(args, None, dataset, model, store=store)
+        init_params = jax.tree.map(jnp.copy, server.aggregator.global_params)
+
+        from fedml_tpu.core.local_trainer import make_local_train_fn
+        from fedml_tpu.core.optimizers import create_client_optimizer
+        from fedml_tpu.core.types import Batches
+
+        trainer = jax.jit(
+            make_local_train_fn(
+                model.apply, model.loss_fn, create_client_optimizer(args), epochs=1
+            )
+        )
+        threads = []
+        for rank in range(1, n_clients + 1):
+            local = Batches(
+                x=dataset.packed_train.x[rank - 1],
+                y=dataset.packed_train.y[rank - 1],
+                mask=dataset.packed_train.mask[rank - 1],
+            )
+            client = EdgeClientSim(
+                args, trainer, local, store, rank=rank, size=n_clients + 1
+            )
+            th = threading.Thread(target=client.run, daemon=True)
+            threads.append(th)
+        server_thread = threading.Thread(target=server.run, daemon=True)
+        server_thread.start()
+        for th in threads:
+            th.start()
+        server_thread.join(120)
+        assert not server_thread.is_alive(), "server did not finish"
+        for th in threads:
+            th.join(30)
+        # two rounds of eval history recorded, model moved off its init
+        assert len(server.aggregator.history) == 2
+        moved = sum(
+            float(jnp.abs(a - b).sum())
+            for a, b in zip(
+                jax.tree.leaves(init_params),
+                jax.tree.leaves(server.aggregator.global_params),
+            )
+        )
+        assert moved > 0
