@@ -221,8 +221,13 @@ def ensure_broker(
             broker = Broker(host, 0)
             _shared_brokers[(broker.host, broker.port)] = broker
             return (broker.host, broker.port)
+    local = _is_local_host(host)
     with _shared_lock:
-        if any(p == port for (_, p) in _shared_brokers):
+        # reuse an in-process broker only when it actually serves this
+        # address (same host, or any same-port broker for local hosts)
+        if (host, port) in _shared_brokers or (
+            local and any(p == port for (_, p) in _shared_brokers)
+        ):
             return (host, port)
     deadline = time.monotonic() + connect_timeout
     while True:
@@ -232,7 +237,7 @@ def ensure_broker(
             return (host, port)
         except OSError:
             pass
-        if _is_local_host(host):
+        if local:
             try:
                 with _shared_lock:
                     broker = Broker(host, port)
@@ -241,7 +246,8 @@ def ensure_broker(
             except OSError as e:
                 if e.errno != errno.EADDRINUSE:
                     raise
-                continue  # lost the bind race -> connect to the winner
+                # lost the bind race -> retry connecting to the winner,
+                # still bounded by the deadline below
         if time.monotonic() >= deadline:
             raise TimeoutError(f"no broker reachable at {host}:{port}")
         time.sleep(0.2)

@@ -42,6 +42,11 @@ class PayloadStore:
     def get(self, url: str) -> bytes:
         raise NotImplementedError
 
+    def exists(self, url: str) -> bool:
+        """Whether a previously returned URL is still fetchable (stores
+        with TTL expiry return False after GC)."""
+        return True
+
 
 class FilePayloadStore(PayloadStore):
     """Shared-directory store; URLs are ``file://`` paths (the S3
@@ -74,6 +79,9 @@ class FilePayloadStore(PayloadStore):
             os.remove(url[len("file://") :])
         except OSError:
             pass
+
+    def exists(self, url: str) -> bool:
+        return os.path.exists(url[len("file://") :])
 
     def _gc(self) -> None:
         import time
@@ -132,7 +140,11 @@ class HybridCommunicationManager(BaseCommunicationManager, Observer):
             if value is not None:
                 data = params_to_bytes(value)
                 digest = hashlib.sha256(data).digest()
-                if self._last_upload is not None and self._last_upload[0] == digest:
+                if (
+                    self._last_upload is not None
+                    and self._last_upload[0] == digest
+                    and self.store.exists(self._last_upload[1])
+                ):
                     url = self._last_upload[1]
                 else:
                     url = self.store.put(data)

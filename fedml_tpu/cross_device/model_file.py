@@ -16,47 +16,27 @@ is lossless for pytrees of arrays.
 from __future__ import annotations
 
 import io
-from typing import Any, Dict
+from typing import Any
 
 import jax
 import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
 
 _SEP = "/"
-
-
-def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
-    flat: Dict[str, np.ndarray] = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
-            flat.update(_flatten(v, key))
-    else:
-        flat[prefix] = np.asarray(tree)
-    return flat
-
-
-def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
-    tree: Dict[str, Any] = {}
-    for key, value in flat.items():
-        parts = key.split(_SEP)
-        node = tree
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = value
-    return tree
 
 
 def params_to_model_bytes(params: Any) -> bytes:
     """Serialize a (nested-dict) param pytree to npz bytes."""
     host = jax.tree.map(np.asarray, params)
+    flat = flatten_dict(host, sep=_SEP)
     buf = io.BytesIO()
-    np.savez(buf, **_flatten(host))
+    np.savez(buf, **flat)
     return buf.getvalue()
 
 
 def model_bytes_to_params(data: bytes) -> Any:
     with np.load(io.BytesIO(data)) as z:
-        return _unflatten({k: z[k] for k in z.files})
+        return unflatten_dict({k: z[k] for k in z.files}, sep=_SEP)
 
 
 def write_model_file(params: Any, path: str) -> None:

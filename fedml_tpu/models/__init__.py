@@ -133,4 +133,23 @@ def create(args, output_dim: int) -> FedModel:
             example_shape=(int(getattr(args, "seq_len", 80)),),
             example_dtype=jnp.int32,
         )
+    if name == "transformer":
+        from .transformer import TransformerLM
+
+        vocab = int(getattr(args, "vocab_size", 1000))
+        seq_len = int(getattr(args, "seq_len", 64))
+        return FedModel(
+            name="transformer_lm",
+            module=TransformerLM(
+                vocab_size=vocab,
+                num_layers=int(getattr(args, "num_layers", 2)),
+                num_heads=int(getattr(args, "num_heads", 4)),
+                embed_dim=int(getattr(args, "embed_dim", 128)),
+                max_len=max(seq_len, int(getattr(args, "max_len", 512))),
+                attention=getattr(args, "attention_impl", "full"),
+            ),
+            task="nwp",
+            example_shape=(seq_len,),
+            example_dtype=jnp.int32,
+        )
     raise ValueError(f"model {name!r} (dataset {ds!r}) not in the model hub")

@@ -1,0 +1,88 @@
+"""Decoder-only transformer for NWP / long-context federated tasks.
+
+The reference's only sequence models are small LSTMs
+(``model/nlp/rnn.py`` — ``RNN_OriginalFedAvg``, ``RNN_StackOverFlow``);
+SURVEY.md §5 marks long-context as green-field. This family is the
+TPU-first successor: bf16-friendly widths, GroupNorm-free pre-LN
+blocks, and a pluggable attention implementation:
+
+- ``attention="full"``  — dense (default single-chip path)
+- ``attention="flash"`` — pallas flash kernel (``ops.flash_attention``)
+- ``attention="ring"`` / ``"ulysses"`` — resolved by the TRAINING STEP:
+  the module calls whatever callable is passed as ``attn_fn``, so a
+  pjit step can inject ``make_sequence_sharded_attention(mesh, ...)``
+  and shard the sequence axis over the mesh ``sp`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _dense_attention(q, k, v):
+    from ..parallel.sequence import full_attention
+
+    return full_attention(q, k, v, causal=True)
+
+
+def _flash(q, k, v):
+    from ..ops.flash_attention import flash_attention
+
+    # largest power-of-two block <= 128 that divides T
+    t = q.shape[1]
+    b = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1) if t % b == 0)
+    return flash_attention(q, k, v, True, None, b, b)
+
+
+def resolve_attention(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    return {"full": _dense_attention, "flash": _flash}[name_or_fn]
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attn_fn: Callable = _dense_attention
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        h = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * C)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.num_heads, C // self.num_heads)
+        o = self.attn_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        x = x + nn.Dense(C)(o.reshape(B, T, C))
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.mlp_ratio * C)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(C)(h)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens [B, T] -> logits [B, T, vocab]."""
+
+    vocab_size: int
+    num_layers: int = 2
+    num_heads: int = 4
+    embed_dim: int = 128
+    max_len: int = 512
+    attention: str = "full"
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        attn = self.attn_fn or resolve_attention(self.attention)
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens.astype(jnp.int32))
+        pos = nn.Embed(self.max_len, self.embed_dim)(jnp.arange(T))
+        x = x + pos[None]
+        for _ in range(self.num_layers):
+            x = Block(num_heads=self.num_heads, attn_fn=attn)(x)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size)(x)

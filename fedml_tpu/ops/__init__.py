@@ -1,0 +1,9 @@
+"""TPU hot-op kernels (pallas).
+
+The reference has no custom kernels (pure torch ops); these are the
+TPU-first replacements for the ops that dominate the new framework's
+workloads. See ``flash_attention`` for the long-context attention
+block.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
