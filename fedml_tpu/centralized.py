@@ -1,0 +1,66 @@
+"""Centralized (non-federated) baseline trainer.
+
+Parity with ``centralized/centralized_trainer.py`` (163 LoC): plain
+training on the coalesced federated dataset, used as the numeric
+baseline the CI equivalence oracles compare against
+(ci/CI-script-fedavg.sh:44-63). Here it is the same jitted scan-based
+local trainer the clients use, pointed at the global split — so
+"federated full-batch == centralized" is a one-line assertion.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import jax
+
+from .core.local_trainer import make_eval_fn, make_local_train_fn
+from .core.optimizers import create_client_optimizer
+
+
+class CentralizedTrainer:
+    def __init__(self, args, device, dataset, model) -> None:
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.history: List[Dict[str, float]] = []
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.rng, init_rng = jax.random.split(self.rng)
+        self.params = model.init(init_rng)
+        self._train_fn = jax.jit(
+            make_local_train_fn(
+                model.apply,
+                model.loss_fn,
+                create_client_optimizer(args),
+                epochs=1,
+                shuffle=bool(getattr(args, "shuffle", True)),
+            )
+        )
+        self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+
+    def train(self) -> Dict[str, float]:
+        epochs = int(getattr(self.args, "epochs", 1))
+        final: Dict[str, float] = {}
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            self.rng, ep_rng = jax.random.split(self.rng)
+            self.params, _ = self._train_fn(
+                self.params, self.dataset.train_data_global, ep_rng
+            )
+            tr = self.model.metrics_from_sums(
+                self._eval(self.params, self.dataset.train_data_global)
+            )
+            te = self.model.metrics_from_sums(
+                self._eval(self.params, self.dataset.test_data_global)
+            )
+            final = {
+                "epoch": epoch,
+                "train_acc": tr["acc"],
+                "train_loss": tr["loss"],
+                "test_acc": te["acc"],
+                "test_loss": te["loss"],
+                "epoch_time_s": time.perf_counter() - t0,
+            }
+            self.history.append(final)
+        return final

@@ -1,0 +1,177 @@
+"""Command-line interface.
+
+Parity with ``python/fedml/cli/cli.py`` (click group ``fedml
+version/login/logout/build``, :17-250), on argparse (no click
+dependency):
+
+- ``version``  — print the package version.
+- ``login``    — persist the account binding and start the edge-agent
+  daemon (the reference spawns ``FedMLClientRunner``,
+  cli/cli.py:27-43 → edge_deployment/login.py:31).
+- ``logout``   — stop the daemon and clear the binding (cli/cli.py:131).
+- ``build``    — package user training code into a client/server
+  distribution zip (cli/cli.py:141-250's mlops-core packaging, minus
+  the platform-specific templates: the package carries the user source
+  + entry + a manifest the edge agent knows how to run).
+
+State lives under ``~/.fedml_tpu/`` (override: FEDML_TPU_HOME).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import zipfile
+
+from . import __version__
+
+
+def _home() -> str:
+    root = os.environ.get(
+        "FEDML_TPU_HOME", os.path.join(os.path.expanduser("~"), ".fedml_tpu")
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _account_path() -> str:
+    return os.path.join(_home(), "account.json")
+
+
+def _pid_path() -> str:
+    return os.path.join(_home(), "edge_agent.pid")
+
+
+def cmd_version(_args) -> int:
+    print(f"fedml_tpu version {__version__}")
+    return 0
+
+
+def cmd_login(args) -> int:
+    account = {
+        "account_id": args.account_id,
+        "server": args.server,
+        "role": args.role,
+        "broker_host": args.broker_host,
+        "broker_port": args.broker_port,
+    }
+    with open(_account_path(), "w") as f:
+        json.dump(account, f)
+    print(f"login: bound account {args.account_id} (role={args.role})")
+    if args.no_daemon:
+        return 0
+    import subprocess
+
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "fedml_tpu.edge_agent",
+            "--account-id",
+            str(args.account_id),
+            "--broker-host",
+            args.broker_host,
+            "--broker-port",
+            str(args.broker_port),
+        ],
+        stdout=open(os.path.join(_home(), "edge_agent.log"), "ab"),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+    with open(_pid_path(), "w") as f:
+        f.write(str(proc.pid))
+    print(f"edge agent daemon started (pid {proc.pid})")
+    return 0
+
+
+def cmd_logout(_args) -> int:
+    if os.path.exists(_pid_path()):
+        try:
+            with open(_pid_path()) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, signal.SIGTERM)
+            print(f"edge agent daemon (pid {pid}) stopped")
+        except (OSError, ValueError):
+            pass
+        os.remove(_pid_path())
+    if os.path.exists(_account_path()):
+        os.remove(_account_path())
+    print("logout: account binding cleared")
+    return 0
+
+
+def cmd_build(args) -> int:
+    """Zip the user's source dir + entry point + manifest
+    (cli/cli.py:141-250's build, without platform templates)."""
+    src = os.path.abspath(args.source_folder)
+    if not os.path.isdir(src):
+        print(f"build: source folder {src!r} not found", file=sys.stderr)
+        return 2
+    entry = args.entry_point
+    if not os.path.exists(os.path.join(src, entry)):
+        print(f"build: entry {entry!r} not in {src!r}", file=sys.stderr)
+        return 2
+    os.makedirs(args.dest_folder, exist_ok=True)
+    out = os.path.join(args.dest_folder, f"fedml_{args.type}_package.zip")
+    manifest = {
+        "type": args.type,
+        "entry": entry,
+        "config": args.config_folder,
+        "version": __version__,
+    }
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        for base, _, files in os.walk(src):
+            for name in files:
+                path = os.path.join(base, name)
+                z.write(path, os.path.relpath(path, src))
+        if args.config_folder:
+            cfg = os.path.abspath(args.config_folder)
+            for base, _, files in os.walk(cfg):
+                for name in files:
+                    path = os.path.join(base, name)
+                    z.write(
+                        path,
+                        os.path.join("config", os.path.relpath(path, cfg)),
+                    )
+        z.writestr("MANIFEST.json", json.dumps(manifest))
+    print(f"build: {args.type} package -> {out}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fedml-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    login = sub.add_parser("login")
+    login.add_argument("account_id")
+    login.add_argument("--server", default="local")
+    login.add_argument("--role", default="client", choices=["client", "edge_server"])
+    login.add_argument("--broker-host", default="127.0.0.1")
+    login.add_argument("--broker-port", type=int, default=18830)
+    login.add_argument("--no-daemon", action="store_true")
+    login.set_defaults(fn=cmd_login)
+
+    sub.add_parser("logout").set_defaults(fn=cmd_logout)
+
+    build = sub.add_parser("build")
+    build.add_argument("-t", "--type", required=True, choices=["client", "server"])
+    build.add_argument("-sf", "--source-folder", required=True)
+    build.add_argument("-ep", "--entry-point", required=True)
+    build.add_argument("-cf", "--config-folder", default=None)
+    build.add_argument("-df", "--dest-folder", default="./dist")
+    build.set_defaults(fn=cmd_build)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

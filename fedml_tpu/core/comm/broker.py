@@ -222,11 +222,14 @@ def ensure_broker(
             _shared_brokers[(broker.host, broker.port)] = broker
             return (broker.host, broker.port)
     local = _is_local_host(host)
+    loopback = host in ("127.0.0.1", "localhost", "")
     with _shared_lock:
-        # reuse an in-process broker only when it actually serves this
-        # address (same host, or any same-port broker for local hosts)
+        # reuse an in-process broker only for the exact bound address,
+        # or same-port loopback aliases; a non-loopback alias of this
+        # machine still gets probed (the broker may be loopback-only
+        # and unreachable at that address)
         if (host, port) in _shared_brokers or (
-            local and any(p == port for (_, p) in _shared_brokers)
+            loopback and any(p == port for (_, p) in _shared_brokers)
         ):
             return (host, port)
     deadline = time.monotonic() + connect_timeout

@@ -47,6 +47,11 @@ class PayloadStore:
         with TTL expiry return False after GC)."""
         return True
 
+    def touch(self, url: str) -> bool:
+        """Refresh a blob's expiry clock so a reused URL outlives the
+        next GC sweep. Returns False if the blob is already gone."""
+        return self.exists(url)
+
 
 class FilePayloadStore(PayloadStore):
     """Shared-directory store; URLs are ``file://`` paths (the S3
@@ -82,6 +87,13 @@ class FilePayloadStore(PayloadStore):
 
     def exists(self, url: str) -> bool:
         return os.path.exists(url[len("file://") :])
+
+    def touch(self, url: str) -> bool:
+        try:
+            os.utime(url[len("file://") :])
+            return True
+        except OSError:
+            return False
 
     def _gc(self) -> None:
         import time
@@ -143,7 +155,7 @@ class HybridCommunicationManager(BaseCommunicationManager, Observer):
                 if (
                     self._last_upload is not None
                     and self._last_upload[0] == digest
-                    and self.store.exists(self._last_upload[1])
+                    and self.store.touch(self._last_upload[1])
                 ):
                     url = self._last_upload[1]
                 else:

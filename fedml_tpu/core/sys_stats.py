@@ -1,0 +1,90 @@
+"""System-resource sampling (SysStats parity).
+
+Reference: ``core/mlops/system_stats.py:8-60`` samples CPU/mem/disk/net
+(+GPU via pynvml) through wandb's SystemStats and ships them to the
+MLOps platform. Here: direct psutil sampling (no wandb dependency) plus
+TPU-side memory stats from the JAX runtime when available; records go
+to the same pluggable-sink ``MetricsReporter`` the rest of the
+framework uses.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+try:
+    import psutil
+
+    _HAS_PSUTIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PSUTIL = False
+
+
+def sample_host_stats() -> Dict[str, Any]:
+    """One snapshot of host CPU/memory/disk/net counters."""
+    if not _HAS_PSUTIL:
+        return {}
+    vm = psutil.virtual_memory()
+    disk = psutil.disk_usage("/")
+    net = psutil.net_io_counters()
+    return {
+        "cpu_util_pct": psutil.cpu_percent(interval=None),
+        "mem_used_gb": vm.used / 2**30,
+        "mem_util_pct": vm.percent,
+        "disk_util_pct": disk.percent,
+        "net_sent_mb": net.bytes_sent / 2**20,
+        "net_recv_mb": net.bytes_recv / 2**20,
+        "proc_rss_gb": psutil.Process().memory_info().rss / 2**30,
+    }
+
+
+def sample_device_stats() -> Dict[str, Any]:
+    """Accelerator memory stats from the JAX runtime (the GPU/pynvml
+    analog for TPU devices); empty when the backend has none."""
+    try:
+        import jax
+
+        stats = {}
+        for i, dev in enumerate(jax.local_devices()):
+            ms = getattr(dev, "memory_stats", lambda: None)()
+            if ms:
+                stats[f"device{i}_bytes_in_use"] = ms.get("bytes_in_use", 0)
+                stats[f"device{i}_peak_bytes"] = ms.get("peak_bytes_in_use", 0)
+        return stats
+    except Exception:  # pragma: no cover - backend-specific
+        return {}
+
+
+class SysStats:
+    """Background sampler publishing to a reporter every ``interval_s``
+    (system_stats.py's sampling loop, minus the wandb indirection)."""
+
+    def __init__(self, reporter, interval_s: float = 10.0) -> None:
+        self.reporter = reporter
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SysStats":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                rec = {"kind": "sys_stats", **sample_host_stats(), **sample_device_stats()}
+                self.reporter.report(rec)
+            except Exception:  # pragma: no cover
+                logging.exception("sys stats sampling failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1)
+            self._thread = None

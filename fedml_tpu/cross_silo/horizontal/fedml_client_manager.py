@@ -71,6 +71,11 @@ class FedMLClientManager(ClientManager):
         super().__init__(args, comm, rank, size, backend)
         self.trainer = trainer
         self.server_rank = 0
+        from ...core.tracking import ProfilerEvent
+
+        # spans mirror the reference's instrumentation points
+        # (client_master_manager.py:117-121: train / comm_c2s)
+        self.profiler = ProfilerEvent(args)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -113,10 +118,12 @@ class FedMLClientManager(ClientManager):
         client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
         self.trainer.update_dataset(client_index)
-        new_params, n = self.trainer.train(params, round_idx)
+        with self.profiler.span("train"):
+            new_params, n = self.trainer.train(params, round_idx)
         out = Message(
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, self.server_rank
         )
         out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         out.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, n)
-        self.send_message(out)
+        with self.profiler.span("comm_c2s"):
+            self.send_message(out)

@@ -42,6 +42,13 @@ class FedMLServerManager(ServerManager):
         self.client_online_status: Dict[int, bool] = {}
         self.client_real_ids = list(range(1, size))  # ranks of clients
         self.is_initialized = False
+        from ...core.tracking import MetricsReporter, ProfilerEvent
+
+        # reference instrumentation points (fedml_server_manager.py:
+        # 71-74, :123-150: server.wait / aggregate spans + round info)
+        self.profiler = ProfilerEvent(args)
+        self.metrics_reporter = MetricsReporter(args, keep_history=False)
+        self._wait_open = False
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -99,10 +106,19 @@ class FedMLServerManager(ServerManager):
         self.aggregator.add_local_trained_result(
             self.client_real_ids.index(sender), model_params, local_sample_num
         )
+        if not self._wait_open:
+            self.profiler.log_event_started("server.wait")
+            self._wait_open = True
         if not self.aggregator.check_whether_all_receive():
             return
-        self.aggregator.aggregate()
+        self.profiler.log_event_ended("server.wait")
+        self._wait_open = False
+        with self.profiler.span("aggregate"):
+            self.aggregator.aggregate()
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        self.metrics_reporter.report(
+            {"kind": "round_info", "round": self.round_idx, "clients": len(self.client_real_ids)}
+        )
         self.round_idx += 1
         if self.round_idx >= self.round_num:
             self.send_finish()

@@ -1,0 +1,109 @@
+"""Edge-agent daemon (FedMLClientRunner parity).
+
+Reference: ``cli/edge_deployment/login.py:31-460`` — a daemon that
+subscribes to MQTT start/stop topics for its account, downloads the run
+package, rewrites local config, spawns the training process, and
+reports status (process bookkeeping :372-441).
+
+TPU-build shape: same lifecycle over the self-hosted broker. Topics:
+``fedml_agent_{account}_start`` / ``..._stop``; the start payload is a
+JSON ``{"run_id", "package_path", "args": {...}}`` pointing at a zip
+built by ``fedml-tpu build``. The agent extracts it, launches the
+manifest entry as a subprocess with the run args on the command line,
+and kills it on stop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import zipfile
+from typing import Dict
+
+from .core.comm.broker import BrokerClient, ensure_broker
+
+
+class EdgeAgent:
+    def __init__(self, account_id: str, broker_host: str, broker_port: int) -> None:
+        self.account_id = str(account_id)
+        host, port = ensure_broker(broker_host, broker_port)
+        self.client = BrokerClient(host, port)
+        self.runs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.client.subscribe(self.topic("start"), self._on_start)
+        self.client.subscribe(self.topic("stop"), self._on_stop)
+        logging.info(
+            "edge agent %s listening on %s:%s", self.account_id, host, port
+        )
+
+    def topic(self, verb: str) -> str:
+        return f"fedml_agent_{self.account_id}_{verb}"
+
+    # -- start: unpack package, spawn entry (login.py:205-320) --------
+    def _on_start(self, _topic: str, payload: bytes) -> None:
+        try:
+            req = json.loads(payload.decode("utf-8"))
+            run_id = str(req["run_id"])
+            workdir = tempfile.mkdtemp(prefix=f"fedml_run_{run_id}_")
+            with zipfile.ZipFile(req["package_path"]) as z:
+                z.extractall(workdir)
+            with open(os.path.join(workdir, "MANIFEST.json")) as f:
+                manifest = json.load(f)
+            cmd = [sys.executable, os.path.join(workdir, manifest["entry"])]
+            for k, v in (req.get("args") or {}).items():
+                cmd += [f"--{k}", str(v)]
+            proc = subprocess.Popen(cmd, cwd=workdir)
+            with self._lock:
+                self.runs[run_id] = proc
+            logging.info("run %s started (pid %d): %s", run_id, proc.pid, cmd)
+        except Exception:
+            logging.exception("start request failed")
+
+    # -- stop: kill the run's process (login.py:308-441) --------------
+    def _on_stop(self, _topic: str, payload: bytes) -> None:
+        try:
+            run_id = str(json.loads(payload.decode("utf-8"))["run_id"])
+            with self._lock:
+                proc = self.runs.pop(run_id, None)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                logging.info("run %s stopped", run_id)
+        except Exception:
+            logging.exception("stop request failed")
+
+    def wait(self) -> None:
+        self._stopped.wait()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for proc in self.runs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            self.runs.clear()
+        self.client.close()
+        self._stopped.set()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fedml_tpu.edge_agent")
+    p.add_argument("--account-id", required=True)
+    p.add_argument("--broker-host", default="127.0.0.1")
+    p.add_argument("--broker-port", type=int, default=18830)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    agent = EdgeAgent(args.account_id, args.broker_host, args.broker_port)
+    signal.signal(signal.SIGTERM, lambda *_: agent.shutdown())
+    agent.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

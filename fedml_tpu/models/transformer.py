@@ -41,7 +41,15 @@ def _flash(q, k, v):
 def resolve_attention(name_or_fn) -> Callable:
     if callable(name_or_fn):
         return name_or_fn
-    return {"full": _dense_attention, "flash": _flash}[name_or_fn]
+    table = {"full": _dense_attention, "flash": _flash}
+    if name_or_fn not in table:
+        raise ValueError(
+            f"attention {name_or_fn!r}: only {sorted(table)} resolve by name; "
+            "'ring'/'ulysses' are mesh-sharded — build them with "
+            "parallel.sequence.make_sequence_sharded_attention(mesh, ...) "
+            "and pass the callable as attn_fn"
+        )
+    return table[name_or_fn]
 
 
 class Block(nn.Module):

@@ -6,10 +6,11 @@ scores lives in VMEM, and the running (max, normalizer, accumulator)
 state carries across k-blocks. Grid: (batch*heads, q-blocks); the
 k-loop is a ``fori_loop`` inside the kernel.
 
-Backward: ``jax.custom_vjp`` recomputes gradients through the dense
-reference attention (mathematically identical); the forward pallas
-kernel is the memory/bandwidth win — O(T) activation residency instead
-of O(T^2). Pair with ``parallel.sequence.ring_attention`` across chips:
+Backward: ``jax.custom_vjp`` with the standard flash residuals
+(output + per-row logsumexp) and a BLOCKWISE recompute — a ``lax.scan``
+over k-blocks that rebuilds one [T, bk] score panel at a time, so the
+backward peak is O(T·bk) like the forward, never the dense [T, T]
+matrix. Pair with ``parallel.sequence.ring_attention`` across chips:
 ring for the sequence axis, this kernel for the per-chip block.
 
 On non-TPU backends the kernel runs in interpreter mode so tests
@@ -28,7 +29,7 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, seq_len):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, seq_len):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
     d = q.shape[-1]
@@ -60,6 +61,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, seq_len)
     upper = jnp.minimum(upper, n_kb)
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp: the backward residual (flash convention)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -77,7 +80,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_len=T
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bq),
         in_specs=[
@@ -85,11 +88,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, T, D), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return (
+        out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, T),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -104,19 +116,51 @@ def flash_attention(
 ):
     """Flash attention, [B, T, H, D] layout. Differentiable."""
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    return flash_attention(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    from ..parallel.sequence import full_attention
+    """Blockwise backward (FlashAttention-2 recompute): scan over
+    k-blocks rebuilding [T, bk] score panels from the saved logsumexp —
+    peak memory O(B·H·T·bk), never the dense [T, T] matrix."""
+    q, k, v, o, lse = res
+    B, T, H, Dh = q.shape
+    sc = scale or (Dh**-0.5)
+    bk = min(block_k, T)
+    f32 = lambda x: x.astype(jnp.float32)
+    qf, kf, vf, of, gf = f32(q), f32(k), f32(v), f32(o), f32(g)
+    # D_i = do_i · o_i  [B,H,T]
+    d_sum = (gf * of).sum(-1).transpose(0, 2, 1)
+    q_pos = jnp.arange(T)
 
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: full_attention(q_, k_, v_, causal, scale), q, k, v)
-    return vjp(g)
+    def body(dq_acc, j):
+        ks = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)  # [B,bk,H,D]
+        vs = jax.lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * sc  # [B,H,T,bk]
+        if causal:
+            k_pos = j * bk + jnp.arange(bk)
+            s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [B,H,T,bk]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vs)
+        ds = p * (dp - d_sum[..., None]) * sc
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros_like(qf), jnp.arange(T // bk)
+    )
+    # [nkb, B, bk, H, D] -> [B, T, H, D]
+    merge = lambda blocks: jnp.moveaxis(blocks, 0, 1).reshape(B, T, H, Dh)
+    return dq.astype(q.dtype), merge(dks).astype(k.dtype), merge(dvs).astype(v.dtype)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -74,7 +74,11 @@ def ring_attention(
     def step(carry, i):
         o, m, l, k_cur, v_cur = carry
         src = (my_idx - i) % n  # owner of the block we currently hold
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale  # [B,H,Tq,Tk]
+        # scores and the online-softmax state accumulate in f32 even for
+        # bf16 inputs — l sums T terms and bf16's 8 mantissa bits drift
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32
+        ) * scale  # [B,H,Tq,Tk] f32
         if causal:
             k_pos = src * Tk + jnp.arange(Tk)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -85,19 +89,22 @@ def ring_attention(
         correction = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])  # [B,H,Tq,Tk]
         l_new = l * correction + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
         o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
         # rotate KV one hop around the ring
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((B, Tq, H, D), q.dtype)
-    m0 = jnp.full((B, H, Tq), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((B, H, Tq), q.dtype)
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
     l_t = l.transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
-    return o / jnp.maximum(l_t, 1e-30)
+    return (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
 
 
 def ulysses_attention(

@@ -1,0 +1,196 @@
+"""CLI, edge agent, centralized trainer, sys stats, span instrumentation."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from fedml_tpu import models
+from fedml_tpu.centralized import CentralizedTrainer
+from fedml_tpu.cli import main as cli_main
+from fedml_tpu.core.sys_stats import SysStats, sample_host_stats
+from fedml_tpu.core.tracking import MetricsReporter, ProfilerEvent
+from fedml_tpu.data import load
+
+
+class TestCLI:
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        assert "fedml_tpu version" in capsys.readouterr().out
+
+    def test_build_packages_source_and_manifest(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "main.py").write_text("print('train')\n")
+        (src / "util.py").write_text("X = 1\n")
+        cfg = tmp_path / "cfg"
+        cfg.mkdir()
+        (cfg / "fedml_config.yaml").write_text("train_args: {}\n")
+        dest = tmp_path / "dist"
+        rc = cli_main(
+            [
+                "build", "-t", "client", "-sf", str(src), "-ep", "main.py",
+                "-cf", str(cfg), "-df", str(dest),
+            ]
+        )
+        assert rc == 0
+        out = dest / "fedml_client_package.zip"
+        with zipfile.ZipFile(out) as z:
+            names = set(z.namelist())
+            assert {"main.py", "util.py", "MANIFEST.json"} <= names
+            assert "config/fedml_config.yaml" in names
+            manifest = json.loads(z.read("MANIFEST.json"))
+            assert manifest["type"] == "client" and manifest["entry"] == "main.py"
+
+    def test_build_rejects_missing_entry(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        assert cli_main(["build", "-t", "server", "-sf", str(src), "-ep", "no.py"]) == 2
+
+    def test_login_logout_no_daemon(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.setenv("FEDML_TPU_HOME", str(tmp_path))
+        assert cli_main(["login", "acct42", "--no-daemon"]) == 0
+        with open(tmp_path / "account.json") as f:
+            assert json.load(f)["account_id"] == "acct42"
+        assert cli_main(["logout"]) == 0
+        assert not (tmp_path / "account.json").exists()
+
+
+class TestEdgeAgent:
+    def test_start_and_stop_run(self, tmp_path):
+        from fedml_tpu.core.comm.broker import Broker, BrokerClient
+        from fedml_tpu.edge_agent import EdgeAgent
+
+        # build a package whose entry writes a marker file then sleeps
+        src = tmp_path / "src"
+        src.mkdir()
+        marker = tmp_path / "started.txt"
+        (src / "main.py").write_text(
+            "import sys, time\n"
+            f"open({str(marker)!r}, 'w').write('ok')\n"
+            "time.sleep(60)\n"
+        )
+        assert cli_main(
+            ["build", "-t", "client", "-sf", str(src), "-ep", "main.py",
+             "-df", str(tmp_path / "dist")]
+        ) == 0
+        pkg = tmp_path / "dist" / "fedml_client_package.zip"
+
+        broker = Broker()
+        agent = EdgeAgent("acctX", broker.host, broker.port)
+        pub = BrokerClient(broker.host, broker.port)
+        time.sleep(0.05)
+        pub.publish(
+            agent.topic("start"),
+            json.dumps({"run_id": "7", "package_path": str(pkg)}).encode(),
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.1)
+        assert marker.exists(), "run entry never started"
+        assert "7" in agent.runs
+        proc = agent.runs["7"]
+        pub.publish(agent.topic("stop"), json.dumps({"run_id": "7"}).encode())
+        deadline = time.time() + 10
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(0.1)
+        assert proc.poll() is not None, "run process not terminated"
+        agent.shutdown()
+        pub.close()
+        broker.stop()
+
+
+class TestCentralizedTrainer:
+    def test_trains_on_coalesced_data(self, args_factory):
+        args = args_factory(
+            dataset="mnist",
+            synthetic_train_size=400,
+            synthetic_test_size=100,
+            model="lr",
+            client_num_in_total=4,
+            client_num_per_round=4,
+            epochs=3,
+            batch_size=50,
+            learning_rate=0.1,
+        )
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        t = CentralizedTrainer(args, None, dataset, model)
+        stats = t.train()
+        assert len(t.history) == 3
+        assert t.history[-1]["train_loss"] < t.history[0]["train_loss"]
+        assert np.isfinite(stats["test_acc"])
+
+
+class TestSysStats:
+    def test_host_sample_has_core_fields(self):
+        s = sample_host_stats()
+        if not s:
+            pytest.skip("psutil unavailable")
+        assert {"cpu_util_pct", "mem_util_pct", "proc_rss_gb"} <= set(s)
+
+    def test_background_sampler_reports(self):
+        reporter = MetricsReporter(keep_history=True)
+        stats = SysStats(reporter, interval_s=0.1).start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not reporter.history:
+            time.sleep(0.05)
+        stats.stop()
+        assert reporter.history, "no sys_stats records"
+        assert reporter.history[0]["kind"] == "sys_stats"
+
+
+class TestSpanInstrumentation:
+    def test_cross_silo_round_records_spans(self, args_factory):
+        """Run one in-process cross-silo round and check the reference's
+        instrumentation points (train / comm_c2s / server.wait /
+        aggregate) produced spans."""
+        import threading
+
+        from fedml_tpu.cross_silo.horizontal.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_silo.horizontal.fedml_client_manager import (
+            FedMLClientManager,
+            FedMLTrainer,
+        )
+        from fedml_tpu.cross_silo.horizontal.fedml_server_manager import (
+            FedMLServerManager,
+        )
+
+        args = args_factory(
+            dataset="mnist",
+            synthetic_train_size=200,
+            synthetic_test_size=40,
+            model="lr",
+            client_num_in_total=2,
+            client_num_per_round=2,
+            comm_round=1,
+            epochs=1,
+            batch_size=25,
+            learning_rate=0.1,
+            run_id="span_test",
+        )
+        dataset = load(args)
+        model = models.create(args, dataset.class_num)
+        agg = FedMLAggregator(args, model, test_data=dataset.test_data_global)
+        server = FedMLServerManager(args, agg, rank=0, size=3)
+        clients = [
+            FedMLClientManager(
+                args, FedMLTrainer(args, dataset, model), rank=r, size=3
+            )
+            for r in (1, 2)
+        ]
+        threads = [threading.Thread(target=m.run, daemon=True) for m in [server] + clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not any(t.is_alive() for t in threads)
+        assert server.profiler.counts["aggregate"] == 1
+        assert server.profiler.counts["server.wait"] == 1
+        assert clients[0].profiler.counts["train"] >= 1
+        assert clients[0].profiler.counts["comm_c2s"] >= 1

@@ -132,6 +132,48 @@ class TestFlashAttention:
         with pytest.raises(ValueError, match="divide"):
             flash_attention(q, k, v, True, None, 48, 48)
 
+    def test_backward_is_blockwise(self):
+        """The custom backward's jaxpr never materializes a [T, T]
+        score matrix — only [T, bk] panels per scan step."""
+
+        def all_shapes(jaxpr):
+            for eqn in jaxpr.eqns:
+                for var in eqn.outvars:
+                    if hasattr(var.aval, "shape"):
+                        yield tuple(var.aval.shape)
+                for p in eqn.params.values():
+                    inner = getattr(p, "jaxpr", p)
+                    if hasattr(inner, "eqns"):
+                        yield from all_shapes(inner)
+
+        q, k, v = _qkv(7)
+        bk = 16
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, True, None, bk, bk) ** 2).sum()
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        shapes = list(all_shapes(jaxpr.jaxpr))
+        assert not any(s[-2:] == (T, T) for s in shapes if len(s) >= 2)
+        assert any(s[-2:] == (T, bk) for s in shapes if len(s) >= 2)
+
+
+class TestBf16Ring:
+    def test_bf16_ring_tracks_f32_oracle(self, sp_mesh):
+        rng = np.random.default_rng(8)
+        mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        want = full_attention(q, k, v, causal=True)
+        attn = make_sequence_sharded_attention(sp_mesh, strategy="ring", causal=True)
+        got = jax.jit(attn)(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+        )
+        assert got.dtype == jnp.bfloat16
+        # f32 accumulation keeps bf16 inputs within bf16 rounding of the
+        # f32 oracle (pure-bf16 accumulation drifts ~10x worse)
+        err = np.abs(np.asarray(got, np.float32) - np.asarray(want)).max()
+        assert err < 0.05, err
+
 
 class TestTransformerFL:
     def test_transformer_federated_training(self, args_factory):
