@@ -48,6 +48,7 @@ MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
 MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
 MSG_TYPE_C2S_CLIENT_STATUS = 5
 MSG_TYPE_S2C_FINISH = 7
+MSG_TYPE_C2S_FINISH_ACK = 8
 MSG_TYPE_CONNECTION_IS_READY = 0
 
 MSG_ARG_KEY_TYPE = "msg_type"

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import errno
 import logging
+import os
 import socket
 import struct
 import threading
@@ -216,7 +217,15 @@ def ensure_broker(
     listener (retrying while the hosting process starts up); only bind
     a new broker when the address is local and free — a lost same-host
     bind race falls back to connecting to the winner."""
+    use_native = os.environ.get("FEDML_TPU_NATIVE_BROKER", "") == "1"
     if port == 0:
+        if use_native:
+            from .native_broker import spawn_native_broker
+
+            spawned = spawn_native_broker(0)
+            if spawned is not None:
+                h, p, _proc = spawned
+                return (h, p)
         with _shared_lock:
             broker = Broker(host, 0)
             _shared_brokers[(broker.host, broker.port)] = broker
@@ -241,6 +250,15 @@ def ensure_broker(
         except OSError:
             pass
         if local:
+            if use_native:
+                from .native_broker import spawn_native_broker
+
+                spawned = spawn_native_broker(port)
+                if spawned is not None:
+                    _h, p, _proc = spawned
+                    return (host, p)
+                # native bind lost a race or toolchain missing -> fall
+                # through to the Python broker / reconnect path
             try:
                 with _shared_lock:
                     broker = Broker(host, port)

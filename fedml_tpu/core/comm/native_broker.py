@@ -1,0 +1,54 @@
+"""Launcher for the native (C++) broker binary.
+
+``native/broker.cpp`` speaks the exact wire protocol of the Python
+:class:`~fedml_tpu.core.comm.broker.Broker`; this module builds it on
+demand and runs it as a child process. ``spawn_native_broker`` parses
+the "LISTENING <port>" handshake so ephemeral ports work. The Python
+broker remains the in-process default — the native one is the
+deployment fabric (and is exercised by the same test suite through
+``BrokerClient``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+from ..native import build_native, native_disabled
+
+
+def build_native_broker() -> Optional[str]:
+    if native_disabled():
+        return None
+    return build_native("broker.cpp", "fedml_broker", ["-pthread"])
+
+
+def spawn_native_broker(
+    port: int = 0, timeout_s: float = 10.0
+) -> Optional[Tuple[str, int, subprocess.Popen]]:
+    """Start the C++ broker; returns (host, port, process) or None when
+    the binary can't be built."""
+    import select
+
+    binary = build_native_broker()
+    if binary is None:
+        return None
+    proc = subprocess.Popen(
+        [binary, str(port)], stdout=subprocess.PIPE, stderr=sys.stderr
+    )
+    ready, _, _ = select.select([proc.stdout], [], [], timeout_s)
+    line = (
+        proc.stdout.readline().decode("utf-8", "replace").strip() if ready else ""
+    )
+    if not line.startswith("LISTENING "):
+        proc.terminate()
+        proc.wait(timeout=5)
+        logging.warning("native broker handshake failed: %r", line)
+        return None
+    bound = int(line.split()[1])
+    atexit.register(proc.terminate)
+    logging.info("native broker on port %d (pid %d)", bound, proc.pid)
+    return ("127.0.0.1", bound, proc)

@@ -81,6 +81,20 @@ def dp_schedule(
     return assign
 
 
+def best_makespan(
+    workloads: Sequence[float], num_resources: int
+) -> Tuple[List[List[int]], float]:
+    """Best available schedule: the native exact branch-and-bound
+    (core.native, C++) when the toolchain is present, else LPT greedy.
+    Never worse than greedy either way."""
+    from .native import exact_makespan
+
+    native = exact_makespan(workloads, num_resources)
+    if native is not None:
+        return native
+    return greedy_makespan(workloads, num_resources)
+
+
 def balance_clients_across_shards(
     client_sizes: Sequence[int], num_shards: int
 ) -> List[List[int]]:

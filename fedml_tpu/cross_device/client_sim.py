@@ -93,5 +93,8 @@ class EdgeClientSim(ClientManager):
     def handle_finish(self, msg: Message) -> None:
         if hasattr(self, "_synced"):
             self._synced.set()
+        self.send_message(
+            Message(constants.MSG_TYPE_C2S_FINISH_ACK, self.rank, 0)
+        )
         logging.info("edge client %d: finish", self.rank)
         self.finish()

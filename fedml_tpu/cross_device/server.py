@@ -111,6 +111,9 @@ class CrossDeviceServerManager(ServerManager):
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client,
         )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_FINISH_ACK, self.handle_finish_ack
+        )
 
     def handle_message_client_status(self, msg: Message) -> None:
         if msg.get(constants.MSG_ARG_KEY_CLIENT_STATUS) == constants.CLIENT_STATUS_ONLINE:
@@ -144,14 +147,27 @@ class CrossDeviceServerManager(ServerManager):
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
         self.round_idx += 1
         if self.round_idx >= self.round_num:
+            # drain: wait for FINISH acks so the broker (often a child
+            # of this process) isn't torn down with messages in flight
+            import threading
+
+            self._finish_acks: Dict[int, bool] = {}
+            self._finish_watchdog = threading.Timer(15.0, self.finish)
+            self._finish_watchdog.daemon = True
+            self._finish_watchdog.start()
             for rank in self.client_ranks:
                 self.send_message(
                     Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
                 )
             logging.info("cross-device server: finished %d rounds", self.round_idx)
-            self.finish()
             return
         self._broadcast_model_file(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+    def handle_finish_ack(self, msg: Message) -> None:
+        self._finish_acks[msg.get_sender_id()] = True
+        if all(self._finish_acks.get(r) for r in self.client_ranks):
+            self._finish_watchdog.cancel()
+            self.finish()
 
 
 class ServerEdge:

@@ -1,0 +1,164 @@
+"""Native C++ runtime: scheduler library + broker binary."""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.native import exact_makespan, lpt_makespan_native
+from fedml_tpu.core.scheduler import best_makespan, greedy_makespan
+
+
+def _brute_force_makespan(w, m):
+    best = float("inf")
+    for assign in itertools.product(range(m), repeat=len(w)):
+        loads = [0.0] * m
+        for j, r in enumerate(assign):
+            loads[r] += w[j]
+        best = min(best, max(loads))
+    return best
+
+
+class TestNativeScheduler:
+    def test_lpt_matches_python(self):
+        rng = np.random.default_rng(0)
+        w = rng.uniform(1, 10, size=40).tolist()
+        got = lpt_makespan_native(w, 5)
+        if got is None:
+            pytest.skip("native toolchain unavailable")
+        assign, ms = got
+        _, ms_py = greedy_makespan(w, 5)
+        assert ms == pytest.approx(ms_py)
+        # a valid partition of all jobs
+        all_jobs = sorted(j for bunch in assign for j in bunch)
+        assert all_jobs == list(range(40))
+
+    def test_bnb_is_exact_on_small_instances(self):
+        rng = np.random.default_rng(1)
+        for trial in range(5):
+            w = rng.uniform(1, 10, size=9).tolist()
+            got = exact_makespan(w, 3)
+            if got is None:
+                pytest.skip("native toolchain unavailable")
+            assign, ms = got
+            assert ms == pytest.approx(_brute_force_makespan(w, 3), rel=1e-9)
+            loads = [sum(w[j] for j in b) for b in assign]
+            assert max(loads) == pytest.approx(ms)
+
+    def test_bnb_beats_or_ties_greedy(self):
+        # classic LPT-suboptimal instance
+        w = [7.0, 7.0, 6.0, 6.0, 5.0, 5.0, 4.0, 4.0, 4.0]
+        got = exact_makespan(w, 3)
+        if got is None:
+            pytest.skip("native toolchain unavailable")
+        _, ms = got
+        _, ms_greedy = greedy_makespan(w, 3)
+        assert ms <= ms_greedy + 1e-9
+        assert ms == pytest.approx(16.0)  # perfect 3-way split of 48
+
+    def test_best_makespan_never_worse_than_greedy(self):
+        rng = np.random.default_rng(2)
+        w = rng.uniform(1, 20, size=14).tolist()
+        _, ms_best = best_makespan(w, 4)
+        _, ms_greedy = greedy_makespan(w, 4)
+        assert ms_best <= ms_greedy + 1e-9
+
+
+class TestNativeBroker:
+    @pytest.fixture(scope="class")
+    def native_broker(self):
+        from fedml_tpu.core.comm.native_broker import spawn_native_broker
+
+        spawned = spawn_native_broker()
+        if spawned is None:
+            pytest.skip("native toolchain unavailable")
+        host, port, proc = spawned
+        yield host, port
+        proc.terminate()
+
+    def test_pub_sub_roundtrip(self, native_broker):
+        from fedml_tpu.core.comm.broker import BrokerClient
+
+        host, port = native_broker
+        got, done = [], threading.Event()
+        a = BrokerClient(host, port)
+        b = BrokerClient(host, port)
+        a.subscribe("t/x", lambda t, p: (got.append(p), done.set()))
+        time.sleep(0.05)
+        b.publish("t/x", b"native-hello")
+        assert done.wait(5)
+        assert got == [b"native-hello"]
+        a.close(), b.close()
+
+    def test_large_payload_concurrent_publishers(self, native_broker):
+        """Multi-MB frames from concurrent publishers arrive intact
+        (per-socket write mutex in the C++ broker)."""
+        from fedml_tpu.core.comm.broker import BrokerClient
+
+        host, port = native_broker
+        n_pub, size = 4, 2 * 1024 * 1024
+        got = []
+        lock = threading.Lock()
+        all_in = threading.Event()
+        sub = BrokerClient(host, port)
+
+        def on_msg(_t, p):
+            with lock:
+                got.append(p)
+                if len(got) == n_pub:
+                    all_in.set()
+
+        sub.subscribe("big", on_msg)
+        time.sleep(0.1)
+        payloads = [bytes([i]) * size for i in range(n_pub)]
+        pubs = [BrokerClient(host, port) for _ in range(n_pub)]
+
+        def send(i):
+            pubs[i].publish("big", payloads[i])
+
+        threads = [threading.Thread(target=send, args=(i,)) for i in range(n_pub)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all_in.wait(30)
+        assert sorted(got) == sorted(payloads)
+        for p in pubs:
+            p.close()
+        sub.close()
+
+    def test_mqtt_backend_over_native_broker(self, native_broker):
+        """The framework's MQTT comm manager runs unchanged over the
+        C++ broker."""
+        from fedml_tpu import constants
+        from fedml_tpu.core.comm.mqtt_backend import MqttCommunicationManager
+        from fedml_tpu.core.message import Message
+
+        host, port = native_broker
+        m0 = MqttCommunicationManager(0, 2, host, port, run_id="native_t")
+        m1 = MqttCommunicationManager(1, 2, host, port, run_id="native_t")
+
+        class Cap:
+            def __init__(self):
+                self.event = threading.Event()
+                self.msg = None
+
+            def receive_message(self, mt, msg):
+                self.msg = (mt, msg)
+                self.event.set()
+
+        cap = Cap()
+        m1.add_observer(cap)
+        t = threading.Thread(target=m1.handle_receive_message, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        msg = Message(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+        msg.add_params("w", np.arange(5.0))
+        m0.send_message(msg)
+        assert cap.event.wait(5)
+        np.testing.assert_array_equal(cap.msg[1].get("w"), np.arange(5.0))
+        m1.stop_receive_message()
+        t.join(5)
+        m0.stop_receive_message()
