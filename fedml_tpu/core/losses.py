@@ -92,8 +92,24 @@ def sigmoid_bce(
     }
 
 
+def pixel_cross_entropy(
+    logits: Array, labels: Array, mask: Array, ignore_index: int = 255
+) -> Tuple[Array, Dict[str, Array]]:
+    """Semantic segmentation (FedSeg trainer semantics): logits
+    [*, H, W, C], labels [*, H, W]; ``mask`` is the per-example
+    validity [*] broadcast over pixels. Pixels labelled
+    ``ignore_index`` (the canonical 255 void label) carry no loss and
+    no metric weight. Counts are in valid pixels; otherwise identical
+    to :func:`token_cross_entropy` with a 2-D "time" axis."""
+    pm = jnp.broadcast_to(mask[..., None, None], labels.shape)
+    pm = pm * (labels != ignore_index)
+    safe_labels = jnp.where(labels == ignore_index, 0, labels)
+    return token_cross_entropy(logits, safe_labels, pm)
+
+
 LOSSES = {
     "classification": softmax_cross_entropy,
     "nwp": token_cross_entropy,
     "tag_prediction": sigmoid_bce,
+    "segmentation": pixel_cross_entropy,
 }

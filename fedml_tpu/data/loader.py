@@ -35,6 +35,7 @@ from .packing import bucket_num_batches, pack_clients, pack_one
 from .synthetic import (
     synthetic_classification,
     synthetic_fedprox,
+    synthetic_segmentation,
     synthetic_sequences,
 )
 
@@ -50,6 +51,11 @@ _DATASET_META = {
     "shakespeare": ((80,), 90, 16000, 2000, "nwp"),
     "fed_shakespeare": ((80,), 90, 16000, 2000, "nwp"),
     "stackoverflow_nwp": ((20,), 10004, 40000, 8000, "nwp"),
+    # federated segmentation (fedseg benchmarks; stand-in shapes keep
+    # H/W modest — a real copy under data_cache_dir overrides)
+    "pascal_voc": ((64, 64, 3), 21, 4000, 800, "segmentation"),
+    "coco_seg": ((64, 64, 3), 81, 4000, 800, "segmentation"),
+    "cityscapes": ((64, 64, 3), 19, 3000, 500, "segmentation"),
 }
 
 
@@ -118,6 +124,9 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
         seq_len, vocab = shape[0], class_num
         x_tr, y_tr = synthetic_sequences(train_n, seq_len, vocab, seed)
         x_te, y_te = synthetic_sequences(test_n, seq_len, vocab, seed + 1)
+    elif task == "segmentation":
+        x_tr, y_tr = synthetic_segmentation(train_n, class_num, shape, seed)
+        x_te, y_te = synthetic_segmentation(test_n, class_num, shape, seed + 1)
     else:
         x_tr, y_tr = synthetic_classification(train_n, class_num, shape, seed)
         x_te, y_te = synthetic_classification(test_n, class_num, shape, seed + 1)
@@ -153,12 +162,32 @@ def load(args) -> FederatedDataset:
         method = getattr(args, "partition_method", constants.PARTITION_HETERO)
         if method == constants.PARTITION_HOMO:
             idx_map = homo_partition(len(y_tr), client_num, seed)
-        else:
+            part_labels = None
+        elif task == "segmentation":
+            # multi-label LDA (the partitioner's fedseg branch): per
+            # foreground class, the index array of images containing it;
+            # void labels (>= class_num, e.g. 255) excluded
+            flat = y_tr.reshape(len(y_tr), -1)
+            per_class = [
+                np.where([(row == k).any() for row in flat])[0]
+                for k in range(class_num)
+            ]
             idx_map = non_iid_partition_with_dirichlet_distribution(
-                y_tr, client_num, class_num,
+                per_class, client_num, class_num,
+                float(getattr(args, "partition_alpha", 0.5)),
+                task="segmentation", seed=seed,
+            )
+            # the same image can carry several classes -> dedupe per client
+            idx_map = {i: np.unique(v) for i, v in idx_map.items()}
+            part_labels = None
+        else:
+            part_labels = y_tr
+            idx_map = non_iid_partition_with_dirichlet_distribution(
+                part_labels, client_num, class_num,
                 float(getattr(args, "partition_alpha", 0.5)), seed=seed,
             )
-        record_data_stats(y_tr, idx_map)
+        if part_labels is not None:
+            record_data_stats(part_labels, idx_map)
         xs_tr = [x_tr[idx_map[i]] for i in range(client_num)]
         ys_tr = [y_tr[idx_map[i]] for i in range(client_num)]
         # test side: shard uniformly (reference gives each client a

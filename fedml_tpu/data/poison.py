@@ -1,0 +1,108 @@
+"""Poisoned / backdoor dataset synthesis.
+
+Reference: ``data/edge_case_examples/data_loader.py`` (1,156 LoC) —
+``load_poisoned_dataset`` builds backdoor training sets (poison types
+``southwest`` / ``ardis`` / ``howto`` / ``greencar-neo``, :205-488):
+attacker clients train on examples relabelled to a target class, some
+carrying an edge-case (out-of-distribution) or trigger pattern. This
+module reproduces the MECHANISMS generically (the reference's types
+are dataset downloads this environment can't fetch):
+
+- ``label_flip``      — y -> (y + 1) % C  (untargeted poisoning)
+- ``targeted_flip``   — y[source] -> target  (targeted misclassification)
+- ``backdoor_pattern``— a corner trigger patch is stamped on a fraction
+  of images which are relabelled to the target (BadNets shape — the
+  trigger analog of the reference's pixel-pattern backdoors)
+- ``edge_case``       — out-of-distribution samples (far-tail noise)
+  labelled as the target class (the southwest-airplane idea)
+
+``poison_clients`` applies an attack to a subset of a federation's
+clients — the adversarial-client setup S-FedAvg / HS-FedAvg / robust
+aggregation defend against (fedavg_robust configs: ``args.poison_type``,
+``poisoned_client_fraction``, ``target_label``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+POISON_TYPES = ("label_flip", "targeted_flip", "backdoor_pattern", "edge_case")
+
+
+def stamp_trigger(x: np.ndarray, size: int = 4, value: float = None) -> np.ndarray:
+    """Stamp a bottom-right square trigger on image batch [N, H, W, C]."""
+    out = np.array(x, copy=True)
+    v = float(out.max()) if value is None else value
+    out[:, -size:, -size:, :] = v
+    return out
+
+
+def poison_dataset(
+    x: np.ndarray,
+    y: np.ndarray,
+    poison_type: str,
+    num_classes: int,
+    target_label: int = 0,
+    source_label: int = 1,
+    fraction: float = 1.0,
+    trigger_size: int = 4,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Return a poisoned copy of (x, y)."""
+    if poison_type not in POISON_TYPES:
+        raise ValueError(f"poison_type {poison_type!r} not in {POISON_TYPES}")
+    rng = np.random.RandomState(seed)
+    x, y = np.array(x, copy=True), np.array(y, copy=True)
+    n = len(y)
+    chosen = rng.permutation(n)[: max(1, int(fraction * n))]
+    if poison_type == "label_flip":
+        y[chosen] = (y[chosen] + 1) % num_classes
+    elif poison_type == "targeted_flip":
+        sel = chosen[np.isin(y[chosen], [source_label])]
+        y[sel] = target_label
+    elif poison_type == "backdoor_pattern":
+        if x.ndim < 4:
+            raise ValueError("backdoor_pattern needs image data [N, H, W, C]")
+        x[chosen] = stamp_trigger(x[chosen], size=trigger_size)
+        y[chosen] = target_label
+    elif poison_type == "edge_case":
+        # far-tail OOD inputs claimed as the target class
+        x[chosen] = 3.0 + rng.normal(0, 0.5, x[chosen].shape).astype(x.dtype)
+        y[chosen] = target_label
+    return x, y
+
+
+def poison_clients(
+    xs: List[np.ndarray],
+    ys: List[np.ndarray],
+    poison_type: str,
+    num_classes: int,
+    poisoned_client_idxs: Sequence[int],
+    **kw,
+) -> Tuple[List[np.ndarray], List[np.ndarray], List[int]]:
+    """Poison the listed clients in-place-by-copy; returns
+    (xs, ys, poisoned idxs)."""
+    xs, ys = list(xs), list(ys)
+    for i in poisoned_client_idxs:
+        xs[i], ys[i] = poison_dataset(
+            xs[i], ys[i], poison_type, num_classes, seed=1000 + i, **kw
+        )
+    return xs, ys, list(poisoned_client_idxs)
+
+
+def backdoor_attack_success_rate(
+    predict_fn, x_clean: np.ndarray, y_clean: np.ndarray,
+    target_label: int, trigger_size: int = 4,
+) -> float:
+    """Fraction of NON-target clean examples the model sends to the
+    target class once the trigger is stamped — the backdoor metric the
+    fork's defense experiments track (per-target-label recall,
+    s_fedavg/fedavg_api.py:218-226)."""
+    keep = y_clean != target_label
+    if keep.sum() == 0:
+        return 0.0
+    triggered = stamp_trigger(x_clean[keep], size=trigger_size)
+    preds = np.asarray(predict_fn(triggered))
+    return float((preds == target_label).mean())

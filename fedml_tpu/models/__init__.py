@@ -133,6 +133,32 @@ def create(args, output_dim: int) -> FedModel:
             example_shape=(int(getattr(args, "seq_len", 80)),),
             example_dtype=jnp.int32,
         )
+    if name == "deeplab":
+        from .deeplab import DeepLabLite
+
+        return FedModel(
+            name="deeplab_lite",
+            module=DeepLabLite(
+                num_classes=output_dim,
+                width=int(getattr(args, "seg_width", 32)),
+            ),
+            task="segmentation",
+            example_shape=_example_shape(args, (64, 64, 3)),
+        )
+    if name == "darts":
+        from .darts import DARTSNetwork
+
+        return FedModel(
+            name="darts_search",
+            module=DARTSNetwork(
+                num_classes=output_dim,
+                width=int(getattr(args, "nas_width", 16)),
+                num_cells=int(getattr(args, "nas_cells", 2)),
+                steps=int(getattr(args, "nas_steps", 2)),
+            ),
+            task="classification",
+            example_shape=_example_shape(args, (32, 32, 3)),
+        )
     if name == "transformer":
         from .transformer import TransformerLM
 

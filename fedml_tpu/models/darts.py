@@ -1,0 +1,169 @@
+"""DARTS differentiable-NAS search space (FedNAS parity).
+
+Reference: ``model/cv/darts/`` (~2.5k LoC: ``model_search.py``,
+``architect.py``, ``genotypes.py``, ``operations.py``) consumed by the
+``fednas`` algorithm — every client trains both network weights and
+architecture parameters (alphas); the server averages BOTH.
+
+TPU-first redesign: a mixed-op cell where each edge computes a
+softmax(alpha)-weighted sum of candidate ops — one fused computation
+per edge, vmap/scan-friendly (the reference holds a python list of op
+modules per edge). Alphas live in the SAME param pytree under ``arch/``
+so FedAvg-style aggregation covers them with zero special casing;
+the bilevel split (weights vs alphas) is done by masking gradients on
+the path prefix, not by separate modules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .resnet import _gn
+
+# candidate operations per edge (operations.py's OPS, GN-normalized)
+PRIMITIVES = ("none", "skip", "conv3", "sep3", "avg_pool", "max_pool")
+
+
+class _Op(nn.Module):
+    kind: str
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind == "none":
+            return jnp.zeros_like(x)
+        if self.kind == "skip":
+            return x
+        if self.kind == "avg_pool":
+            return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.kind == "max_pool":
+            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.kind == "conv3":
+            h = nn.Conv(self.features, (3, 3), use_bias=False)(nn.relu(x))
+            return _gn(self.features)(h)
+        if self.kind == "sep3":  # depthwise separable
+            h = nn.Conv(
+                self.features, (3, 3), feature_group_count=self.features,
+                use_bias=False,
+            )(nn.relu(x))
+            h = nn.Conv(self.features, (1, 1), use_bias=False)(h)
+            return _gn(self.features)(h)
+        raise ValueError(self.kind)
+
+
+class MixedEdge(nn.Module):
+    """softmax(alpha)-weighted sum over candidate ops
+    (model_search.py MixedOp)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        w = jax.nn.softmax(alpha)
+        outs = [ _Op(kind=p, features=self.features)(x) for p in PRIMITIVES ]
+        return sum(wi * o for wi, o in zip(w, outs))
+
+
+class Cell(nn.Module):
+    """DAG cell: each intermediate node sums mixed edges from all
+    predecessors (model_search.py Cell; steps=2 keeps the search space
+    real — 5 edges/cell — while staying compile-friendly)."""
+
+    features: int
+    steps: int = 2
+
+    @nn.compact
+    def __call__(self, s0, alphas):
+        # alphas: [n_edges, n_primitives]
+        states = [s0]
+        edge = 0
+        for _ in range(self.steps):
+            cur = sum(
+                MixedEdge(features=self.features)(h, alphas[edge + j])
+                for j, h in enumerate(states)
+            )
+            edge += len(states)
+            states.append(cur)
+        return jnp.concatenate(states[1:], axis=-1)
+
+
+def num_edges(steps: int) -> int:
+    return sum(1 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Searchable net: stem -> cells -> head. Architecture parameters
+    are a param leaf at ``params['arch']['alphas']``."""
+
+    num_classes: int
+    width: int = 16
+    num_cells: int = 2
+    steps: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        alphas = self.param(
+            "alphas_holder",
+            lambda key: 1e-3
+            * jax.random.normal(key, (num_edges(self.steps), len(PRIMITIVES))),
+        )
+        x = x.astype(jnp.float32)
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = _gn(self.width)(x)
+        for i in range(self.num_cells):
+            x = Cell(features=self.width, steps=self.steps)(x, alphas)
+            # project concat(states) back to width; relu is load-bearing:
+            # with few channels the GN is per-channel (instance norm),
+            # whose spatial mean is exactly 0 — GAP without a
+            # nonlinearity would zero the head's input
+            x = nn.Conv(self.width, (1, 1), use_bias=False)(x)
+            x = nn.relu(_gn(self.width)(x))
+            if i == self.num_cells // 2 and self.num_cells > 1:
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))  # reduction
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def arch_path(params) -> Tuple[str, ...]:
+    """Locate the alphas leaf in the param tree."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, _ in flat:
+        keys = tuple(getattr(p, "key", str(p)) for p in path)
+        if keys[-1] == "alphas_holder":
+            return keys
+    raise KeyError("alphas_holder not in params")
+
+
+def split_grad_masks(params):
+    """(weight_mask, arch_mask) pytrees of 0/1 — the bilevel split
+    (architect.py separates w and alpha optimizers)."""
+    target = arch_path(params)
+
+    def mask(path, leaf, want_arch: bool):
+        keys = tuple(getattr(p, "key", str(p)) for p in path)
+        is_arch = keys == target
+        return jnp.ones_like(leaf) if (is_arch == want_arch) else jnp.zeros_like(leaf)
+
+    w_mask = jax.tree_util.tree_map_with_path(
+        lambda p, l: mask(p, l, False), params
+    )
+    a_mask = jax.tree_util.tree_map_with_path(
+        lambda p, l: mask(p, l, True), params
+    )
+    return w_mask, a_mask
+
+
+def genotype(alphas: jax.Array, steps: int = 2) -> List[Tuple[int, str]]:
+    """Discrete architecture: per edge, the argmax primitive excluding
+    'none' (genotypes.py derivation)."""
+    out: List[Tuple[int, str]] = []
+    a = jnp.asarray(alphas)
+    none_idx = PRIMITIVES.index("none")
+    for e in range(num_edges(steps)):
+        scores = a[e].at[none_idx].set(-jnp.inf)
+        out.append((e, PRIMITIVES[int(jnp.argmax(scores))]))
+    return out

@@ -432,6 +432,7 @@ def _algorithms():
     from .decentralized import DecentralizedDSGDAPI, DecentralizedPushSumAPI
     from .defenses import HSFedAvgAPI, SFedAvgAPI
     from .fedgan import FedGANAPI
+    from .fednas import FedNASAPI
     from .hierarchical_fl import HierarchicalFLAPI
     from .split_learning import FedGKTAPI, SplitNNAPI, VFLAPI
     from .turboaggregate import TurboAggregateAPI
@@ -451,6 +452,7 @@ def _algorithms():
         "SplitNN": SplitNNAPI,
         "FedGKT": FedGKTAPI,
         "VFL": VFLAPI,
+        "FedNAS": FedNASAPI,
     }
 
 

@@ -1,0 +1,201 @@
+"""FedNAS: federated differentiable architecture search.
+
+Reference: ``simulation/mpi_p2p_mp/fednas`` (894 LoC) + the DARTS
+search space: each round, every client alternates an ARCHITECT step
+(alphas on its validation half, first-order DARTS — ``architect.py``
+with unrolled=False) with a WEIGHT step (network weights on its
+training half); the server averages both weights and alphas
+(``FedNASAggregator``).
+
+TPU-first: one jitted round — the alternating bilevel scan is vmapped
+across the cohort; the w/alpha split is gradient masking over one param
+pytree, so aggregation is the same stacked weighted mean as FedAvg.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.aggregation import normalize_weights, weighted_average
+from ..core.types import Batches
+from ..data.loader import FederatedDataset
+from ..models.darts import DARTSNetwork, arch_path, genotype, split_grad_masks
+from .fedavg_api import deterministic_client_sampling
+
+Params = Any
+
+
+class FedNASAPI:
+    """Args: ``nas_width``, ``nas_cells``, ``nas_steps``,
+    ``arch_learning_rate`` (reference arch_lr), ``learning_rate``."""
+
+    algorithm = "FedNAS"
+
+    def __init__(self, args, device, dataset: FederatedDataset, model=None, mesh=None):
+        self.args = args
+        self.dataset = dataset
+        self.history: List[Dict[str, float]] = []
+        cls = dataset.class_num
+        # the model hub's 'darts' entry builds the search network from
+        # the same args; reuse it so hyperparameters live in one place
+        if model is not None and isinstance(
+            getattr(model, "module", None), DARTSNetwork
+        ):
+            self.net = model.module
+        else:
+            self.net = DARTSNetwork(
+                num_classes=cls,
+                width=int(getattr(args, "nas_width", 16)),
+                num_cells=int(getattr(args, "nas_cells", 2)),
+                steps=int(getattr(args, "nas_steps", 2)),
+            )
+        img_shape = tuple(dataset.packed_train.x.shape[-3:])
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.rng, init_rng = jax.random.split(self.rng)
+        self.global_params = self.net.init(
+            init_rng, jnp.zeros((1,) + img_shape)
+        )["params"]
+        self._arch_keys = arch_path(self.global_params)
+
+        self.w_opt = optax.sgd(float(getattr(args, "learning_rate", 0.025)), momentum=0.9)
+        self.a_opt = optax.adam(float(getattr(args, "arch_learning_rate", 3e-4)))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self._build_jitted()
+
+    def _build_jitted(self) -> None:
+        net = self.net
+        w_opt, a_opt = self.w_opt, self.a_opt
+        epochs = self.epochs
+
+        def loss_fn(p, x, y, m):
+            logits = net.apply({"params": p}, x)
+            logp = jax.nn.log_softmax(logits)
+            per = -jnp.take_along_axis(
+                logp, y[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            count = m.sum()
+            loss = (per * m).sum() / jnp.maximum(count, 1.0)
+            correct = ((jnp.argmax(logits, -1) == y) * m).sum()
+            return loss, {"correct": correct, "count": count}
+
+        def local_search(params, batches: Batches, rng):
+            """Alternating first-order DARTS. The local train/val halves
+            are split along the EXAMPLE axis of every batch (not by
+            batch slot: padding lives in the tail batches, so slot-wise
+            halving would hand small clients an all-padding validation
+            half and silently freeze them)."""
+            w_mask, a_mask = split_grad_masks(params)
+            bs = batches.mask.shape[-1]
+            h = bs // 2
+            tr = jax.tree.map(lambda a: a[:, :h], batches)
+            va = jax.tree.map(lambda a: a[:, h:], batches)
+            w_state = w_opt.init(params)
+            a_state = a_opt.init(params)
+
+            def step(carry, batch):
+                p, ws, as_ = carry
+                tx, ty, tm, vx, vy, vm = batch
+                # architect step: alphas on the validation half
+                # (skipped when this batch's val half is pure padding)
+                (vl, _), g = jax.value_and_grad(loss_fn, has_aux=True)(p, vx, vy, vm)
+                g_a = jax.tree.map(jnp.multiply, g, a_mask)
+                ua, as_new = a_opt.update(g_a, as_, p)
+                p_a = optax.apply_updates(p, ua)
+                has_val = vm.sum() > 0
+                keep = lambda c, a, b: jax.tree.map(
+                    lambda u, v: jnp.where(c, u, v), a, b
+                )
+                p_a = keep(has_val, p_a, p)
+                as_new = keep(has_val, as_new, as_)
+                # weight step: w on the training half
+                (tl, metrics), g2 = jax.value_and_grad(loss_fn, has_aux=True)(
+                    p_a, tx, ty, tm
+                )
+                g_w = jax.tree.map(jnp.multiply, g2, w_mask)
+                uw, ws_new = w_opt.update(g_w, ws, p_a)
+                p_w = optax.apply_updates(p_a, uw)
+                has_train = tm.sum() > 0
+                return (
+                    keep(has_train, p_w, p_a),
+                    keep(has_train, ws_new, ws),
+                    as_new,
+                ), {"loss_sum": tl * metrics["count"], **metrics}
+
+            def epoch(carry, _):
+                carry, ms = jax.lax.scan(
+                    step, carry, (tr.x, tr.y, tr.mask, va.x, va.y, va.mask)
+                )
+                return carry, jax.tree.map(jnp.sum, ms)
+
+            (params, _, _), per_epoch = jax.lax.scan(
+                epoch, (params, w_state, a_state), None, length=epochs
+            )
+            return params, jax.tree.map(lambda a: a[-1], per_epoch)
+
+        def round_fn(global_params, packed: Batches, nsamples, idx, rng):
+            cohort = jax.tree.map(lambda a: jnp.take(a, idx, axis=0), packed)
+            ns = jnp.take(nsamples, idx)
+            rngs = jax.random.split(rng, idx.shape[0])
+            stacked, ms = jax.vmap(local_search, in_axes=(None, 0, 0))(
+                global_params, cohort, rngs
+            )
+            # FedNASAggregator: weights AND alphas averaged together
+            new_global = weighted_average(stacked, normalize_weights(ns))
+            return new_global, jax.tree.map(jnp.sum, ms)
+
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0,))
+
+        def evaluate(params, test: Batches):
+            def estep(_, batch):
+                x, y, m = batch
+                loss, metrics = loss_fn(params, x, y, m)
+                return None, {"loss_sum": loss * metrics["count"], **metrics}
+
+            _, out = jax.lax.scan(estep, None, (test.x, test.y, test.mask))
+            return jax.tree.map(jnp.sum, out)
+
+        self._evaluate = jax.jit(evaluate)
+
+    def current_alphas(self) -> jax.Array:
+        node = self.global_params
+        for k in self._arch_keys:
+            node = node[k]
+        return node
+
+    def current_genotype(self):
+        return genotype(self.current_alphas(), steps=int(getattr(self.args, "nas_steps", 2)))
+
+    def train(self) -> Dict[str, float]:
+        args = self.args
+        packed = self.dataset.packed_train
+        nsamples = jnp.asarray(self.dataset.packed_num_samples)
+        freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
+        final: Dict[str, float] = {}
+        for round_idx in range(int(args.comm_round)):
+            t0 = time.perf_counter()
+            idx = deterministic_client_sampling(
+                round_idx, self.dataset.client_num, int(args.client_num_per_round)
+            )
+            self.rng, r_rng = jax.random.split(self.rng)
+            self.global_params, ms = self._round_fn(
+                self.global_params, packed, nsamples, jnp.asarray(idx), r_rng
+            )
+            if round_idx % freq == 0 or round_idx == int(args.comm_round) - 1:
+                ev = self._evaluate(self.global_params, self.dataset.test_data_global)
+                stats = {
+                    "round": round_idx,
+                    "round_time_s": time.perf_counter() - t0,
+                    "train_loss": float(ms["loss_sum"]) / max(float(ms["count"]), 1.0),
+                    "test_acc": float(ev["correct"]) / max(float(ev["count"]), 1.0),
+                    "test_loss": float(ev["loss_sum"]) / max(float(ev["count"]), 1.0),
+                    "genotype": str(self.current_genotype()),
+                }
+                self.history.append(stats)
+                final = stats
+        return final
