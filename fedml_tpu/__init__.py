@@ -113,6 +113,30 @@ def run_cross_silo_client(args: Optional[Arguments] = None):
     return client.run()
 
 
+def run_hierarchical_cross_silo_server(args: Optional[Arguments] = None):
+    """One-line hierarchical cross-silo server (__init__.py:214-233).
+    Protocol-identical to the horizontal server — the hierarchy lives
+    entirely client-side (each FL client is a sharded training group)."""
+    return run_cross_silo_server(args)
+
+
+def run_hierarchical_cross_silo_client(args: Optional[Arguments] = None):
+    """One-line hierarchical cross-silo client (__init__.py:235-253):
+    master/slave role follows ``args.proc_rank_in_silo`` the way the
+    reference forks on the torchrun-derived process rank."""
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    from . import data, device, models
+    from .cross_silo import HierarchicalClient
+
+    args = init(args)
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    client = HierarchicalClient(args, dev, dataset, model)
+    return client.run()
+
+
 def run_edge_server(args: Optional[Arguments] = None):
     """One-line cross-device server — the ``run_mnn_server`` analog
     (__init__.py:256-274): edge clients ship model files over the

@@ -63,3 +63,10 @@ MSG_ARG_KEY_MODEL_FILE_URL = "model_file_url"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_STATUS_IDLE = "IDLE"
+
+# Hierarchical cross-silo intra-silo control plane (reference:
+# cross_silo/hierarchical/client_master_manager.py:239-249 broadcasts
+# [round_idx, model, client_index] via dist.broadcast_object_list; here
+# the same triple travels as a message on a silo-private fabric).
+MSG_TYPE_SILO_SYNC_PROCESS_GROUP = 20
+MSG_TYPE_SILO_FINISH = 21

@@ -15,7 +15,17 @@ from .horizontal.fedml_aggregator import FedMLAggregator
 from .horizontal.fedml_client_manager import FedMLClientManager, FedMLTrainer
 from .horizontal.fedml_server_manager import FedMLServerManager
 
-__all__ = ["Client", "Server"]
+__all__ = ["Client", "Server", "HierarchicalClient"]
+
+
+def __getattr__(name):
+    # lazy: hierarchical pulls in jax.sharding; keep the horizontal
+    # import path light
+    if name == "HierarchicalClient":
+        from .hierarchical import HierarchicalClient
+
+        return HierarchicalClient
+    raise AttributeError(name)
 
 
 def _world_size(args) -> int:

@@ -1,0 +1,75 @@
+"""Hierarchical cross-silo ("Octopus", SURVEY.md §2.10 hierarchical).
+
+Each FL client is itself a distributed training group: the reference
+nests a PyTorch-DDP process group inside every silo
+(``cross_silo/hierarchical/trainer_dist_adapter.py:40-141`` wraps the
+model in DDP, ``process_group_manager.py:6-43`` builds NCCL/GLOO
+groups, ``client_master_manager.py:48-269`` speaks the FL protocol
+outward and broadcasts inward, ``client_slave_manager.py:5-54`` blocks
+on the broadcast).
+
+TPU-native redesign — the silo's data parallelism is a **mesh axis, not
+a process group**:
+
+- in-silo DP = the silo's local batch sharded over a ``data`` axis of a
+  per-silo ``jax.sharding.Mesh``; XLA inserts the gradient all-reduce
+  over ICI (the DDP allreduce analog) during jit, no NCCL calls;
+- the master process drives the jitted sharded train step and speaks
+  the horizontal FL protocol to the server (same 3-message loop);
+- slave processes exist for **multi-controller** runs (one process per
+  host of a multi-host silo): they block on the silo-private control
+  fabric for ``[round_idx, params, client_index]`` and enter the same
+  jitted computation so the collectives complete. Under a
+  single-controller runtime (one process drives all silo chips —
+  ``jax.process_count() == 1``) the master's step already uses every
+  chip and slaves skip the redundant compute.
+"""
+
+from __future__ import annotations
+
+from .client_master_manager import ClientMasterManager
+from .client_slave_manager import ClientSlaveManager
+from .process_group_manager import ProcessGroupManager, silo_fabric_name
+from .trainer_dist_adapter import TrainerDistAdapter
+
+__all__ = [
+    "ClientMasterManager",
+    "ClientSlaveManager",
+    "ProcessGroupManager",
+    "TrainerDistAdapter",
+    "HierarchicalClient",
+    "silo_fabric_name",
+]
+
+
+class HierarchicalClient:
+    """Facade: one process of one silo. Role (master/slave) follows
+    ``proc_rank_in_silo`` exactly as the reference forks on
+    ``process_id`` (``fedml_hierarchical_api.py``)."""
+
+    def __init__(self, args, device, dataset, model, silo_devices=None) -> None:
+        self.args = args
+        pg = ProcessGroupManager(args)
+        trainer = TrainerDistAdapter(
+            args, dataset, model, pg, silo_devices=silo_devices
+        )
+        if pg.is_master():
+            from .. import _world_size
+            from ... import constants
+
+            rank = int(getattr(args, "rank", 1))
+            if rank < 1:
+                raise ValueError("silo FL rank must be >= 1 (0 is the server)")
+            self.manager = ClientMasterManager(
+                args,
+                trainer,
+                pg,
+                rank=rank,
+                size=_world_size(args),
+                backend=getattr(args, "backend", constants.COMM_BACKEND_LOCAL),
+            )
+        else:
+            self.manager = ClientSlaveManager(args, trainer, pg)
+
+    def run(self) -> None:
+        self.manager.run()

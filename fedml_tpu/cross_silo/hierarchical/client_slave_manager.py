@@ -1,0 +1,49 @@
+"""Silo slave: block on the round broadcast, join the silo's step.
+
+Parity with ``cross_silo/hierarchical/client_slave_manager.py:5-54``
+(``await_sync_process_group`` :39-50 blocks on the rank-0 broadcast,
+then trains). The slave never talks to the FL server — its whole world
+is the silo-private control fabric plus the silo's SPMD computation.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ... import constants
+from ...core.comm.local import LocalCommunicationManager
+from ...core.message import Message
+
+
+class ClientSlaveManager:
+    def __init__(self, args, trainer, process_group) -> None:
+        self.args = args
+        self.trainer = trainer
+        self.pg = process_group
+        self._com = LocalCommunicationManager(
+            self.pg.fabric_name, self.pg.proc_rank_in_silo, self.pg.n_proc_in_silo
+        )
+        self._finished = False
+
+    def await_sync_process_group(self) -> None:
+        """(client_slave_manager.py:39-50)"""
+        inbox = self._com.fabric.inbox(self.pg.proc_rank_in_silo)
+        msg = inbox.get()
+        if not isinstance(msg, Message) or msg.get_type() == constants.MSG_TYPE_SILO_FINISH:
+            self._finished = True
+            return
+        round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
+        params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
+        self.trainer.update_dataset(int(client_index))
+        self.trainer.participate(params, round_idx)
+
+    def run(self) -> None:
+        while not self._finished:
+            self.await_sync_process_group()
+        logging.info(
+            "silo slave %d/%d: finish",
+            self.pg.proc_rank_in_silo,
+            self.pg.n_proc_in_silo,
+        )
+        self.pg.cleanup()

@@ -1,0 +1,68 @@
+"""Silo process-group bookkeeping.
+
+TPU analog of ``cross_silo/hierarchical/process_group_manager.py:6-43``:
+the reference calls ``dist.init_process_group`` (NCCL/GLOO) plus a
+second ``new_group()`` for control messaging. Here the compute group is
+the JAX runtime itself — for multi-host silos,
+``jax.distributed.initialize`` (the runtime's own process group) is
+invoked once; collectives then ride ICI/DCN under jit with no backend
+objects to manage. The control group is a silo-private message fabric
+(in-process queues or any configured transport) carrying the
+master->slave round broadcast.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+def silo_fabric_name(args) -> str:
+    """Silo-private control-fabric name (one fabric per FL client)."""
+    run_id = getattr(args, "run_id", "0")
+    silo = int(getattr(args, "rank", 1))  # FL rank of this silo's client
+    return f"hier_{run_id}_silo{silo}"
+
+
+class ProcessGroupManager:
+    """Identity + lifecycle of one process inside a silo.
+
+    ``n_proc_in_silo`` / ``proc_rank_in_silo`` mirror the reference's
+    torchrun-derived env (``fedml/__init__.py:85-130``). When
+    ``args.distributed_coordinator`` is set this is a multi-controller
+    run: each silo process is a JAX host process and we join the
+    runtime's process group (``jax.distributed.initialize``).
+    """
+
+    def __init__(self, args) -> None:
+        self.args = args
+        self.n_proc_in_silo = int(getattr(args, "n_proc_in_silo", 1) or 1)
+        self.proc_rank_in_silo = int(getattr(args, "proc_rank_in_silo", 0) or 0)
+        self.fabric_name = silo_fabric_name(args)
+        coordinator = getattr(args, "distributed_coordinator", None)
+        self.multi_controller = bool(coordinator)
+        if self.multi_controller:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=self.n_proc_in_silo,
+                process_id=self.proc_rank_in_silo,
+            )
+            logging.info(
+                "silo process group: joined %s as %d/%d",
+                coordinator,
+                self.proc_rank_in_silo,
+                self.n_proc_in_silo,
+            )
+
+    def is_master(self) -> bool:
+        return self.proc_rank_in_silo == 0
+
+    def slave_ranks(self):
+        return range(1, self.n_proc_in_silo)
+
+    def cleanup(self) -> None:
+        if self.multi_controller:
+            import jax
+
+            jax.distributed.shutdown()

@@ -53,7 +53,19 @@ class FedMLAggregator:
     def add_local_trained_result(
         self, index: int, model_params: Params, sample_num: float
     ) -> None:
-        """(fedml_aggregator.py:58-63)"""
+        """(fedml_aggregator.py:58-63)
+
+        Incoming trees may live on a client-private device subset (a
+        hierarchical silo's DP mesh, where params are replicated) —
+        reconcile onto the server's device only when the device sets
+        actually differ, so the in-process zero-copy path stays
+        zero-copy. Note: FedAvg-family servers aggregate full param
+        trees by design; a model-parallel (sharded-params) silo would
+        need a sharded server aggregation path instead of this."""
+        server_dev = jax.devices()[0]
+        leaves = jax.tree.leaves(model_params)
+        if leaves and isinstance(leaves[0], jax.Array) and leaves[0].sharding.device_set != {server_dev}:
+            model_params = jax.device_put(model_params, server_dev)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True

@@ -1,0 +1,138 @@
+"""Hierarchical cross-silo: FL round x in-silo data parallelism.
+
+Oracle (VERDICT round 1, next-round #2): hierarchical == horizontal
+numerics on the 8-device mesh — 2 silos, each data-sharding its batch
+4-way, must produce the same global model as 2 plain horizontal
+clients. The in-silo DP mesh axis replaces the reference's DDP process
+group (cross_silo/hierarchical/trainer_dist_adapter.py:40-141), so the
+only permitted difference is floating-point reduction order.
+"""
+
+import threading
+
+import jax
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.data import load
+
+
+def _mk_args(make, run_id, **kw):
+    base = dict(
+        training_type="cross_silo",
+        dataset="mnist",
+        synthetic_train_size=256,
+        synthetic_test_size=64,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=2,
+        client_num_per_round=2,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+        backend="LOCAL",
+        run_id=run_id,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+def _build(args_factory, run_id, rank, **kw):
+    a = _mk_args(args_factory, run_id, **kw)
+    a.rank = rank
+    a = fedml_tpu.init(a)
+    ds = load(a)
+    m = models.create(a, ds.class_num)
+    return a, ds, m
+
+
+def _run_hier_world(args_factory, run_id, n_silos=2, n_proc_in_silo=2, **kw):
+    from fedml_tpu.cross_silo import HierarchicalClient, Server
+
+    a0, ds0, m0 = _build(args_factory, run_id, 0, **kw)
+    server = Server(a0, None, ds0, m0)
+
+    actors = []
+    for silo_rank in range(1, n_silos + 1):
+        for proc in range(n_proc_in_silo):
+            a, ds, m = _build(
+                args_factory,
+                run_id,
+                silo_rank,
+                silo_device_count=8 // n_silos,
+                n_proc_in_silo=n_proc_in_silo,
+                proc_rank_in_silo=proc,
+                **kw,
+            )
+            actors.append(HierarchicalClient(a, None, ds, m))
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in actors]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "hierarchical actors hung"
+    return server
+
+
+def _run_horizontal_world(args_factory, run_id, n_clients=2, **kw):
+    from fedml_tpu.cross_silo import Client, Server
+
+    a0, ds0, m0 = _build(args_factory, run_id, 0, **kw)
+    server = Server(a0, None, ds0, m0)
+    clients = []
+    for r in range(1, n_clients + 1):
+        a, ds, m = _build(args_factory, run_id, r, **kw)
+        clients.append(Client(a, None, ds, m))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return server
+
+
+class TestHierarchicalCrossSilo:
+    def test_master_slave_round_loop_completes(self, args_factory, eight_devices):
+        server = _run_hier_world(args_factory, run_id="hier1")
+        assert server.manager.round_idx == 2
+
+    def test_hierarchical_matches_horizontal(self, args_factory, eight_devices):
+        hier = _run_hier_world(args_factory, run_id="hier2")
+        flat = _run_horizontal_world(args_factory, run_id="hier2flat")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            hier.aggregator.get_global_model_params(),
+            flat.aggregator.get_global_model_params(),
+        )
+
+    def test_single_proc_silo_degenerates_cleanly(self, args_factory, eight_devices):
+        server = _run_hier_world(args_factory, run_id="hier3", n_proc_in_silo=1)
+        assert server.manager.round_idx == 2
+
+    def test_silo_batch_is_data_sharded(self, args_factory, eight_devices):
+        """The silo trainer really shards the example axis: its batch
+        sharding spans the silo's 4 devices."""
+        from fedml_tpu.cross_silo.hierarchical import (
+            ProcessGroupManager,
+            TrainerDistAdapter,
+        )
+
+        a, ds, m = _build(
+            args_factory, "hier4", 1, silo_device_count=4, n_proc_in_silo=1
+        )
+        adapter = TrainerDistAdapter(a, ds, m, ProcessGroupManager(a))
+        adapter.update_dataset(0)
+        batch = adapter._silo_batch()
+        assert len(batch.x.sharding.device_set) == 4
+        # example axis split 4 ways -> each shard holds bs/4 examples
+        shard_shape = batch.x.addressable_shards[0].data.shape
+        assert shard_shape[1] == batch.x.shape[1] // 4
