@@ -19,7 +19,12 @@ import numpy as np
 
 from . import constants  # noqa: F401
 from .arguments import Arguments, load_arguments
-from .core.frame import ClientTrainer, ServerAggregator  # noqa: F401
+from .core.frame import (  # noqa: F401
+    ClientTrainer,
+    DefaultClientTrainer,
+    DefaultServerAggregator,
+    ServerAggregator,
+)
 
 __version__ = "0.1.0"
 
@@ -58,8 +63,14 @@ def _seed(seed: int) -> None:
     np.random.seed(seed)
 
 
-def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP) -> None:
-    """One-line simulation entry (__init__.py:139-169)."""
+def run_simulation(
+    backend: str = constants.FEDML_SIMULATION_TYPE_SP,
+    client_trainer=None,
+    server_aggregator=None,
+) -> None:
+    """One-line simulation entry (__init__.py:139-169). Custom L3
+    operators (``core.frame``) plug in via ``client_trainer=`` /
+    ``server_aggregator=``."""
     global _global_training_type, _global_comm_backend
     _global_training_type = constants.FEDML_TRAINING_PLATFORM_SIMULATION
     _global_comm_backend = backend
@@ -75,15 +86,21 @@ def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP) -> None:
         constants.FEDML_SIMULATION_TYPE_MESH,
         constants.FEDML_SIMULATION_TYPE_NCCL,
     ):
-        simulator = SimulatorMesh(args, dev, dataset, model)
+        simulator = SimulatorMesh(
+            args, dev, dataset, model,
+            client_trainer=client_trainer, server_aggregator=server_aggregator,
+        )
     elif backend == constants.FEDML_SIMULATION_TYPE_SP:
-        simulator = SimulatorSingleProcess(args, dev, dataset, model)
+        simulator = SimulatorSingleProcess(
+            args, dev, dataset, model,
+            client_trainer=client_trainer, server_aggregator=server_aggregator,
+        )
     else:
         raise ValueError(f"unknown simulation backend {backend!r}")
     return simulator.run()
 
 
-def run_cross_silo_server(args: Optional[Arguments] = None):
+def run_cross_silo_server(args: Optional[Arguments] = None, server_aggregator=None):
     """One-line cross-silo server (__init__.py:172-191)."""
     global _global_training_type
     _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
@@ -94,11 +111,11 @@ def run_cross_silo_server(args: Optional[Arguments] = None):
     dev = device.get_device(args)
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
-    server = Server(args, dev, dataset, model)
+    server = Server(args, dev, dataset, model, server_aggregator=server_aggregator)
     return server.run()
 
 
-def run_cross_silo_client(args: Optional[Arguments] = None):
+def run_cross_silo_client(args: Optional[Arguments] = None, client_trainer=None):
     """One-line cross-silo client (__init__.py:193-211)."""
     global _global_training_type
     _global_training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
@@ -109,18 +126,22 @@ def run_cross_silo_client(args: Optional[Arguments] = None):
     dev = device.get_device(args)
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
-    client = Client(args, dev, dataset, model)
+    client = Client(args, dev, dataset, model, client_trainer=client_trainer)
     return client.run()
 
 
-def run_hierarchical_cross_silo_server(args: Optional[Arguments] = None):
+def run_hierarchical_cross_silo_server(
+    args: Optional[Arguments] = None, server_aggregator=None
+):
     """One-line hierarchical cross-silo server (__init__.py:214-233).
     Protocol-identical to the horizontal server — the hierarchy lives
     entirely client-side (each FL client is a sharded training group)."""
-    return run_cross_silo_server(args)
+    return run_cross_silo_server(args, server_aggregator=server_aggregator)
 
 
-def run_hierarchical_cross_silo_client(args: Optional[Arguments] = None):
+def run_hierarchical_cross_silo_client(
+    args: Optional[Arguments] = None, client_trainer=None
+):
     """One-line hierarchical cross-silo client (__init__.py:235-253):
     master/slave role follows ``args.proc_rank_in_silo`` the way the
     reference forks on the torchrun-derived process rank."""
@@ -133,7 +154,7 @@ def run_hierarchical_cross_silo_client(args: Optional[Arguments] = None):
     dev = device.get_device(args)
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
-    client = HierarchicalClient(args, dev, dataset, model)
+    client = HierarchicalClient(args, dev, dataset, model, client_trainer=client_trainer)
     return client.run()
 
 

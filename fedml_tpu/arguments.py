@@ -39,6 +39,10 @@ _DEFAULTS: Dict[str, Any] = {
     "data_cache_dir": "./data_cache",
     "partition_method": constants.PARTITION_HETERO,
     "partition_alpha": 0.5,
+    # padded-packing long-tail policy: shared num_batches is clamped to
+    # waste_cap x median client size; samples beyond it are truncated
+    # (pack_clients logs what was dropped). float("inf") disables.
+    "packing_waste_cap": 4.0,
     # model
     "model": "lr",
     # training

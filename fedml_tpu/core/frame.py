@@ -1,32 +1,64 @@
-"""L3 algorithm frame: the framework-agnostic operator pair.
+"""L3 algorithm frame: the framework's central extension point.
 
 Parity with ``python/fedml/core/alg_frame/client_trainer.py:4-40`` and
-``server_aggregator.py:4-35``: stateless operators holding ``model`` +
-``id`` with get/set params, train, test. Here "params" are pytrees of
-``jax.Array`` instead of torch state_dicts, and the default concrete
-implementations (``fedml_tpu/simulation/trainer.py``) are built from the
-jitted functional core, so custom trainers can still be registered by
-subclassing these ABCs exactly like in the reference.
+``server_aggregator.py:4-35``: users customize federated training by
+subclassing a ``ClientTrainer`` / ``ServerAggregator`` pair and handing
+it to any scenario. Here the seam is TPU-first: the abstract method is a
+**pure-function factory** —
+
+- ``ClientTrainer.make_train_fn(args)`` returns
+  ``fn(params, batches, rng) -> (new_params, metrics)``, pure and
+  traceable. The engines take that ONE function and jit it (cross-silo),
+  vmap it over the cohort (single-process simulation), or shard it over
+  a mesh (mesh simulation / hierarchical silo DP) — a custom trainer is
+  automatically correct in every scenario instead of being re-ported per
+  backend the way the reference quintuplicates trainers.
+- ``ServerAggregator.aggregate(global_params, stacked_params, weights,
+  rng)`` is a pure pytree reduction over the stacked cohort axis; the
+  simulation engine calls it inside the jitted round, cross-silo calls
+  it on received models.
+
+The reference's imperative surface (``get/set_model_params``,
+``train(train_data, device, args)``, ``test``) is provided on top of the
+functional core so operator code written against the reference's ABC
+shape still reads the same.
+
+Default implementations: :class:`DefaultClientTrainer` (the functional
+core from ``core.local_trainer``) and :class:`DefaultServerAggregator`
+(sample-weighted FedAvg mean). Scenarios build these when no custom
+operator is supplied — see ``simulation/fedavg_api.py``,
+``cross_silo/__init__.py``.
 """
 
 from __future__ import annotations
 
 import abc
-from typing import Any
+from typing import Any, Callable, Dict, Tuple
 
 Params = Any
+TrainFn = Callable[[Params, Any, Any], Tuple[Params, Dict[str, Any]]]
 
 
 class ClientTrainer(abc.ABC):
-    """Abstract client operator (client_trainer.py:4-40)."""
+    """Abstract client operator (client_trainer.py:4-40).
+
+    Subclasses implement :meth:`make_train_fn`; everything else has
+    working defaults. ``model`` is a :class:`fedml_tpu.models.spec.FedModel`;
+    "params" are pytrees of ``jax.Array``.
+    """
 
     def __init__(self, model, args=None) -> None:
         self.model = model
         self.id = 0
         self.args = args
+        self.params: Params = None
         self.local_train_dataset = None
         self.local_test_dataset = None
         self.local_sample_number = 0
+        self._jitted_train = None
+        self._jitted_train_args = None
+        self._jitted_eval = None
+        self._train_calls = 0
 
     def set_id(self, trainer_id) -> None:
         self.id = trainer_id
@@ -36,23 +68,81 @@ class ClientTrainer(abc.ABC):
         self.local_test_dataset = test_data
         self.local_sample_number = sample_num
 
+    # -- functional seam (the part subclasses write) -------------------
     @abc.abstractmethod
+    def make_train_fn(self, args) -> TrainFn:
+        """Return the pure local-training function
+        ``fn(params, batches, rng) -> (new_params, metrics)``.
+
+        Must be traceable (jit/vmap-safe): no Python side effects, no
+        data-dependent Python control flow. ``batches`` is a
+        :class:`fedml_tpu.core.types.Batches` ([nb, bs, ...] + mask);
+        ``metrics`` must include ``loss_sum`` / ``correct`` / ``count``.
+        """
+
+    # -- reference-parity imperative surface ---------------------------
     def get_model_params(self) -> Params:
-        ...
+        return self.params
 
-    @abc.abstractmethod
     def set_model_params(self, model_parameters: Params) -> None:
-        ...
+        self.params = model_parameters
 
-    @abc.abstractmethod
-    def train(self, train_data, device, args) -> None:
-        ...
+    def train(self, train_data, device=None, args=None):
+        """Imperative wrapper over the functional core
+        (client_trainer.py ``train(train_data, device, args)``)."""
+        import jax
 
-    def test(self, test_data, device, args):
-        raise NotImplementedError
+        args = args if args is not None else self.args
+        if self._jitted_train is None or args is not self._jitted_train_args:
+            self._jitted_train = jax.jit(self.make_train_fn(args))
+            self._jitted_train_args = args
+        # distinct key per (trainer id, call #): repeated round calls
+        # must not replay the same shuffle permutation
+        self._train_calls += 1
+        rng = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0)), self.id
+            ),
+            self._train_calls,
+        )
+        self.params, metrics = self._jitted_train(self.params, train_data, rng)
+        return self.params
 
-    def test_on_the_server(self, train_data_local_dict, test_data_local_dict, device, args=None) -> bool:
+    def test(self, test_data, device=None, args=None):
+        import jax
+
+        from .local_trainer import make_eval_fn
+
+        if self._jitted_eval is None:
+            self._jitted_eval = jax.jit(
+                make_eval_fn(self.model.apply, self.model.loss_fn)
+            )
+        return self.model.metrics_from_sums(self._jitted_eval(self.params, test_data))
+
+    def test_on_the_server(
+        self, train_data_local_dict, test_data_local_dict, device=None, args=None
+    ) -> bool:
         return False
+
+
+class DefaultClientTrainer(ClientTrainer):
+    """The stock operator: masked scan-based SGD local training
+    (``core.local_trainer.make_local_train_fn``), FedProx-aware via
+    ``args.fedprox_mu``. What every scenario uses unless a custom
+    trainer is passed."""
+
+    def make_train_fn(self, args) -> TrainFn:
+        from .local_trainer import make_local_train_fn
+        from .optimizers import create_client_optimizer
+
+        return make_local_train_fn(
+            self.model.apply,
+            self.model.loss_fn,
+            create_client_optimizer(args),
+            epochs=int(args.epochs),
+            prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
+            shuffle=bool(getattr(args, "shuffle", True)),
+        )
 
 
 class ServerAggregator(abc.ABC):
@@ -62,24 +152,52 @@ class ServerAggregator(abc.ABC):
         self.model = model
         self.id = 0
         self.args = args
+        self.params: Params = None
+        self._jitted_eval = None
 
     def set_id(self, aggregator_id) -> None:
         self.id = aggregator_id
 
-    @abc.abstractmethod
     def get_model_params(self) -> Params:
-        ...
+        return self.params
 
-    @abc.abstractmethod
     def set_model_params(self, model_parameters: Params) -> None:
-        ...
+        self.params = model_parameters
 
+    # -- functional seam -----------------------------------------------
     @abc.abstractmethod
-    def aggregate(self, raw_client_model_list) -> Params:
-        ...
+    def aggregate(
+        self, global_params: Params, stacked_params: Params, weights, rng
+    ) -> Params:
+        """Pure reduction over the stacked cohort axis.
 
-    def test(self, test_data, device, args):
-        raise NotImplementedError
+        ``stacked_params`` leaves are ``[C, ...]`` (client axis
+        leading); ``weights`` is ``[C]`` summing to 1. Called INSIDE the
+        jitted round by the simulation engines — must be traceable.
+        """
 
-    def test_on_the_server(self, train_data_local_dict, test_data_local_dict, device, args=None) -> bool:
+    def test(self, test_data, device=None, args=None):
+        import jax
+
+        from .local_trainer import make_eval_fn
+
+        if self._jitted_eval is None:
+            self._jitted_eval = jax.jit(
+                make_eval_fn(self.model.apply, self.model.loss_fn)
+            )
+        return self.model.metrics_from_sums(self._jitted_eval(self.params, test_data))
+
+    def test_on_the_server(
+        self, train_data_local_dict, test_data_local_dict, device=None, args=None
+    ) -> bool:
         return False
+
+
+class DefaultServerAggregator(ServerAggregator):
+    """The stock operator: sample-weighted FedAvg mean
+    (``core.aggregation.weighted_average``)."""
+
+    def aggregate(self, global_params, stacked_params, weights, rng) -> Params:
+        from .aggregation import weighted_average
+
+        return weighted_average(stacked_params, weights)

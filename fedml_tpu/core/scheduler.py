@@ -6,12 +6,13 @@ memory constraints, minimizing makespan — the "Parrot" scheduling seed
 (SURVEY.md §2.6). ``DP_schedule(mode)`` produces per-resource job
 "bunches" (scheduler.py:110-172).
 
-In this framework the scheduler has a real consumer the reference never
-wired up: balancing simulated clients across mesh shards. With padded
-client batching, each device trains max(nb_i) batches — packing clients
-so per-shard total work is even is exactly this makespan problem
-(``balance_clients_across_shards``, used by the mesh simulator's
-bucketing).
+Like the reference's scheduler, this is a standalone service (SURVEY.md
+§2.6: "not yet wired into the round loop"): under the current padded
+packing every client trains the same number of (masked) batches, so
+shard assignment cannot change the makespan and the mesh simulator does
+not consume it. ``balance_clients_across_shards`` is the consumer-ready
+seam for when packing becomes per-shard-bucketed (different nb per shard
+group); today it is exercised by tests only.
 """
 
 from __future__ import annotations

@@ -33,10 +33,13 @@ def _world_size(args) -> int:
 
 
 class Server:
-    def __init__(self, args, device, dataset, model) -> None:
+    def __init__(self, args, device, dataset, model, server_aggregator=None) -> None:
         self.args = args
         aggregator = FedMLAggregator(
-            args, model, test_data=dataset.test_data_global if dataset else None
+            args,
+            model,
+            test_data=dataset.test_data_global if dataset else None,
+            server_aggregator=server_aggregator,
         )
         self.aggregator = aggregator
         self.manager = FedMLServerManager(
@@ -55,12 +58,12 @@ class Server:
 
 
 class Client:
-    def __init__(self, args, device, dataset, model) -> None:
+    def __init__(self, args, device, dataset, model, client_trainer=None) -> None:
         self.args = args
         rank = int(getattr(args, "rank", 1))
         if rank < 1:
             raise ValueError("cross-silo client rank must be >= 1 (0 is the server)")
-        trainer = FedMLTrainer(args, dataset, model)
+        trainer = FedMLTrainer(args, dataset, model, client_trainer=client_trainer)
         self.trainer = trainer
         self.manager = FedMLClientManager(
             args,

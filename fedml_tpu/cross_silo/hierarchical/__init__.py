@@ -47,11 +47,14 @@ class HierarchicalClient:
     ``proc_rank_in_silo`` exactly as the reference forks on
     ``process_id`` (``fedml_hierarchical_api.py``)."""
 
-    def __init__(self, args, device, dataset, model, silo_devices=None) -> None:
+    def __init__(
+        self, args, device, dataset, model, silo_devices=None, client_trainer=None
+    ) -> None:
         self.args = args
         pg = ProcessGroupManager(args)
         trainer = TrainerDistAdapter(
-            args, dataset, model, pg, silo_devices=silo_devices
+            args, dataset, model, pg, silo_devices=silo_devices,
+            client_trainer=client_trainer,
         )
         if pg.is_master():
             from .. import _world_size

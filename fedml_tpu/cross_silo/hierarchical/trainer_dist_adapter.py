@@ -58,6 +58,7 @@ class TrainerDistAdapter:
         model,
         process_group,
         silo_devices: Optional[Sequence[jax.Device]] = None,
+        client_trainer=None,
     ) -> None:
         self.args = args
         self.dataset = dataset
@@ -85,15 +86,22 @@ class TrainerDistAdapter:
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, self._batch_spec)
 
-        self._fn = jax.jit(
-            make_local_train_fn(
+        if client_trainer is not None:
+            # L3 operator seam (core/frame.py): the custom pure train fn
+            # is simply jitted with the silo's DP shardings — in-silo
+            # data parallelism composes with custom operators for free.
+            local_fn = client_trainer.make_train_fn(args)
+        else:
+            local_fn = make_local_train_fn(
                 model.apply,
                 model.loss_fn,
                 create_client_optimizer(args),
                 epochs=int(args.epochs),
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
-            ),
+            )
+        self._fn = jax.jit(
+            local_fn,
             # params/opt-state replicated, batch data-sharded: exactly
             # the DDP layout, declared instead of hand-implemented.
             in_shardings=(

@@ -28,10 +28,12 @@ Params = Any
 
 
 class FedMLAggregator:
-    def __init__(self, args, model, test_data=None) -> None:
+    def __init__(self, args, model, test_data=None, server_aggregator=None) -> None:
         self.args = args
         self.model = model
         self.test_data = test_data
+        self.server_aggregator = server_aggregator
+        self._agg_round = 0
         self.client_num = int(args.client_num_per_round)
         self.model_dict: Dict[int, Params] = {}
         self.sample_num_dict: Dict[int, float] = {}
@@ -87,7 +89,19 @@ class FedMLAggregator:
         trees = [self.model_dict[i] for i in range(self.client_num)]
         ns = jnp.asarray([self.sample_num_dict[i] for i in range(self.client_num)])
         stacked = stack_pytrees(trees)
-        self.global_params = weighted_average(stacked, normalize_weights(ns))
+        weights = normalize_weights(ns)
+        if self.server_aggregator is not None:
+            # L3 operator seam (core/frame.py): custom pure reduction
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
+                self._agg_round,
+            )
+            self.global_params = self.server_aggregator.aggregate(
+                self.global_params, stacked, weights, rng
+            )
+        else:
+            self.global_params = weighted_average(stacked, weights)
+        self._agg_round += 1
         self.model_dict.clear()
         self.sample_num_dict.clear()
         return self.global_params

@@ -26,13 +26,17 @@ class FedMLTrainer:
     """(fedml_trainer.py:4-60): holds the local data dict and the
     jitted update; ``update_dataset(index)`` switches silo."""
 
-    def __init__(self, args, dataset, model) -> None:
+    def __init__(self, args, dataset, model, client_trainer=None) -> None:
         self.args = args
         self.dataset = dataset
         self.model = model
         self.client_index: Optional[int] = None
-        self._fn = jax.jit(
-            make_local_train_fn(
+        if client_trainer is not None:
+            # L3 operator seam (core/frame.py): same custom pure train
+            # fn the simulators consume, here jitted per-silo.
+            fn = client_trainer.make_train_fn(args)
+        else:
+            fn = make_local_train_fn(
                 model.apply,
                 model.loss_fn,
                 create_client_optimizer(args),
@@ -40,7 +44,7 @@ class FedMLTrainer:
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
             )
-        )
+        self._fn = jax.jit(fn)
 
     def update_dataset(self, client_index: int) -> None:
         self.client_index = int(client_index)

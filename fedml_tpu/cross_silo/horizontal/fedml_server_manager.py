@@ -25,6 +25,26 @@ from ...core.managers import ServerManager
 from ...core.message import Message
 
 
+def _resolve_client_real_ids(args, size: int):
+    """Client-id indirection (fedml_server_manager.py:33): edge devices
+    carry real ids from ``args.client_id_list`` (JSON string or list);
+    without one, ids default to the transport ranks 1..size-1."""
+    raw = getattr(args, "client_id_list", None)
+    if raw:
+        if isinstance(raw, str):
+            import json
+
+            raw = json.loads(raw)
+        ids = [int(i) for i in raw]
+        if size and len(ids) != size - 1:
+            raise ValueError(
+                f"client_id_list has {len(ids)} entries but comm world has "
+                f"{size - 1} clients"
+            )
+        return ids
+    return list(range(1, size))
+
+
 class FedMLServerManager(ServerManager):
     def __init__(
         self,
@@ -40,7 +60,14 @@ class FedMLServerManager(ServerManager):
         self.round_num = int(args.comm_round)
         self.round_idx = 0
         self.client_online_status: Dict[int, bool] = {}
-        self.client_real_ids = list(range(1, size))  # ranks of clients
+        # Identity vs address: ``client_real_ids`` are edge-device
+        # IDENTITIES (selection, reporting); transport ADDRESSES are
+        # ranks 1..size-1. Position p in the list <-> rank p+1 (the
+        # reference's rank<->real-id convention, fedml_server_manager.py:33).
+        self.client_real_ids = _resolve_client_real_ids(args, size)
+        self._rank_of_real_id = {
+            rid: pos + 1 for pos, rid in enumerate(self.client_real_ids)
+        }
         self.is_initialized = False
         from ...core.tracking import MetricsReporter, ProfilerEvent
 
@@ -65,9 +92,10 @@ class FedMLServerManager(ServerManager):
         """(fedml_server_manager.py:95-119)"""
         status = msg.get(constants.MSG_ARG_KEY_CLIENT_STATUS)
         if status == constants.CLIENT_STATUS_ONLINE:
-            self.client_online_status[msg.get_sender_id()] = True
+            self.client_online_status[int(msg.get_sender_id())] = True
         all_online = all(
-            self.client_online_status.get(r, False) for r in self.client_real_ids
+            self.client_online_status.get(rank, False)
+            for rank in range(1, len(self.client_real_ids) + 1)
         )
         if all_online and not self.is_initialized:
             self.is_initialized = True
@@ -82,16 +110,17 @@ class FedMLServerManager(ServerManager):
         (fedml_server_manager.py:47-69 and :167-207): pick which edge
         ranks participate (``client_selection``), map them onto data-silo
         indices (``data_silo_selection``), send the global model."""
-        receiver_ranks = self.aggregator.client_selection(
+        selected_real_ids = self.aggregator.client_selection(
             self.round_idx, self.client_real_ids, len(self.client_real_ids)
         )
         silo_indexes = self.aggregator.data_silo_selection(
             self.round_idx,
             int(self.args.client_num_in_total),
-            len(receiver_ranks),
+            len(selected_real_ids),
         )
         global_params = self.aggregator.get_global_model_params()
-        for rank, silo_idx in zip(receiver_ranks, silo_indexes):
+        for real_id, silo_idx in zip(selected_real_ids, silo_indexes):
+            rank = self._rank_of_real_id[real_id]
             msg = Message(msg_type, self.rank, rank)
             msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
@@ -100,11 +129,11 @@ class FedMLServerManager(ServerManager):
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         """(fedml_server_manager.py:121-207)"""
-        sender = msg.get_sender_id()
+        sender_rank = int(msg.get_sender_id())
         model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
-            self.client_real_ids.index(sender), model_params, local_sample_num
+            sender_rank - 1, model_params, local_sample_num
         )
         if not self._wait_open:
             self.profiler.log_event_started("server.wait")
@@ -127,7 +156,7 @@ class FedMLServerManager(ServerManager):
         self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
 
     def send_finish(self) -> None:
-        for rank in self.client_real_ids:
+        for rank in range(1, len(self.client_real_ids) + 1):
             self.send_message(
                 Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
             )

@@ -200,12 +200,13 @@ def load(args) -> FederatedDataset:
 
     x_dtype = jnp.int32 if task == "nwp" else jnp.float32
 
+    waste_cap = float(getattr(args, "packing_waste_cap", 4.0) or 4.0)
     sizes = [len(x) for x in xs_tr]
-    nb = bucket_num_batches(sizes, batch_size)
+    nb = bucket_num_batches(sizes, batch_size, waste_cap=waste_cap)
     packed_train, num_samples = pack_clients(
         xs_tr, ys_tr, batch_size, num_batches=nb, x_dtype=x_dtype
     )
-    nb_te = bucket_num_batches([len(x) for x in xs_te], batch_size)
+    nb_te = bucket_num_batches([len(x) for x in xs_te], batch_size, waste_cap=waste_cap)
     packed_test, _ = pack_clients(
         xs_te, ys_te, batch_size, num_batches=nb_te, x_dtype=x_dtype
     )

@@ -11,6 +11,7 @@ ONE pytree — ready for vmap or for sharding the client axis over a mesh.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,6 +71,18 @@ def pack_clients(
     """
     if num_batches is None:
         num_batches = max(max(1, -(-len(x) // batch_size)) for x in xs)
+    cap_ = num_batches * batch_size
+    truncated = [(i, len(x) - cap_) for i, x in enumerate(xs) if len(x) > cap_]
+    if truncated:
+        dropped = sum(d for _, d in truncated)
+        total = sum(len(x) for x in xs)
+        logging.warning(
+            "pack_clients: long-tail truncation — %d/%d clients exceed "
+            "num_batches=%d x batch_size=%d; dropping %d/%d samples "
+            "(%.2f%%). Raise args.packing_waste_cap to keep them.",
+            len(truncated), len(xs), num_batches, batch_size,
+            dropped, total, 100.0 * dropped / max(total, 1),
+        )
     packed = [
         pack_one(x, y, batch_size, num_batches, x_dtype=x_dtype, allow_truncate=True)
         for x, y in zip(xs, ys)
@@ -91,7 +104,11 @@ def pack_clients(
 def bucket_num_batches(sizes: List[int], batch_size: int, waste_cap: float = 4.0) -> int:
     """Heuristic shared nb: cap padding waste by clamping to
     ``waste_cap`` x median client size (huge-client tail gets truncated
-    batches dropped rather than blowing up every client's padding)."""
+    batches dropped rather than blowing up every client's padding).
+    ``waste_cap`` is user-facing as ``args.packing_waste_cap``; raising
+    it trades padding memory for keeping the long tail's samples
+    (``pack_clients`` logs exactly what a given cap drops); ``inf``
+    disables truncation entirely."""
     nbs = [max(1, -(-s // batch_size)) for s in sizes]
     med = float(np.median(nbs))
     return int(min(max(nbs), max(1.0, waste_cap * med)))

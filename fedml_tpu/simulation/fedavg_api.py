@@ -80,6 +80,10 @@ class FedAvgAPI:
     # scoring, secure aggregation) flip this to get the stacked cohort
     # params as a 4th round output
     _keep_stacked = False
+    # subclasses whose server step IS the algorithm (FedOpt's optax
+    # update, FedNova's normalized combine) flip this off so a custom
+    # server_aggregator errors instead of being silently dropped
+    _accepts_custom_aggregator = True
 
     def __init__(
         self,
@@ -88,12 +92,21 @@ class FedAvgAPI:
         dataset: FederatedDataset,
         model: FedModel,
         mesh=None,
+        client_trainer=None,
+        server_aggregator=None,
     ) -> None:
         self.args = args
         self.device = device
         self.dataset = dataset
         self.model = model
         self.mesh = mesh
+        if server_aggregator is not None and not self._accepts_custom_aggregator:
+            raise ValueError(
+                f"{self.algorithm} defines its own server aggregation; a "
+                "custom server_aggregator would be ignored — not supported"
+            )
+        self.client_trainer = client_trainer
+        self.server_aggregator = server_aggregator
         self.mode = getattr(args, "sim_mode", "vectorized")
         if self.mode == "sequential" and (
             self._keep_stacked
@@ -109,19 +122,26 @@ class FedAvgAPI:
         self.rng, init_rng = jax.random.split(self.rng)
         self.global_params = model.init(init_rng)
 
-        prox_mu = (
-            float(getattr(args, "fedprox_mu", 0.0))
-            if self.algorithm == "FedProx"
-            else 0.0
-        )
-        self._local_train = make_local_train_fn(
-            model.apply,
-            model.loss_fn,
-            create_client_optimizer(args),
-            epochs=int(args.epochs),
-            prox_mu=prox_mu,
-            shuffle=bool(getattr(args, "shuffle", True)),
-        )
+        if client_trainer is not None:
+            # L3 operator seam (core/frame.py): the custom trainer's
+            # pure train fn replaces the stock one; the engine vmaps /
+            # mesh-shards it identically.
+            client_trainer.set_id(0)
+            self._local_train = client_trainer.make_train_fn(args)
+        else:
+            prox_mu = (
+                float(getattr(args, "fedprox_mu", 0.0))
+                if self.algorithm == "FedProx"
+                else 0.0
+            )
+            self._local_train = make_local_train_fn(
+                model.apply,
+                model.loss_fn,
+                create_client_optimizer(args),
+                epochs=int(args.epochs),
+                prox_mu=prox_mu,
+                shuffle=bool(getattr(args, "shuffle", True)),
+            )
         self._eval = make_eval_fn(model.apply, model.loss_fn)
         self.robust = (
             RobustAggregator(args) if getattr(args, "defense_type", None) else None
@@ -150,6 +170,16 @@ class FedAvgAPI:
         rng: jax.Array,
     ) -> Tuple[Params, Any]:
         """FedAvg: weighted average (fedavg_api.py:206-221)."""
+        if self.server_aggregator is not None:
+            # L3 operator seam: custom pure reduction, runs inside the
+            # jitted round (robust/defense wrapping is then the custom
+            # aggregator's own responsibility).
+            return (
+                self.server_aggregator.aggregate(
+                    global_params, new_stacked, weights, rng
+                ),
+                server_state,
+            )
         if self.robust is not None:
             return (
                 self.robust.aggregate(new_stacked, weights, global_params, rng),
@@ -384,6 +414,7 @@ class FedOptAPI(FedAvgAPI):
     optimizer (sgd/momentum/adam/adagrad/yogi replaces OptRepo)."""
 
     algorithm = "FedOpt"
+    _accepts_custom_aggregator = False
 
     def _init_server_state(self):
         self._server_opt = create_server_optimizer(self.args)
@@ -410,6 +441,7 @@ class FedNovaAPI(FedAvgAPI):
     client optimizer (momentum-corrected a_i is a later extension)."""
 
     algorithm = "FedNova"
+    _accepts_custom_aggregator = False
 
     def _aggregate(self, global_params, server_state, new_stacked, weights, cohort, rng):
         if cohort is None:

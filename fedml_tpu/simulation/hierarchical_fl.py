@@ -33,6 +33,9 @@ class HierarchicalFLAPI(FedAvgAPI):
     number of GLOBAL rounds (reference ``global_comm_round``)."""
 
     algorithm = "HierFedAvg"
+    # group level consults the seam via _round_fn, but the global level
+    # is a fixed group-weighted mean — mixed semantics, so reject
+    _accepts_custom_aggregator = False
 
     def _groups(self) -> List[np.ndarray]:
         n = self.dataset.client_num

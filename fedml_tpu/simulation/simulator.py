@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 
 from ..parallel.mesh import build_mesh, pad_federation, replicate, shard_federation
-from .fedavg_api import get_algorithms
+from .fedavg_api import FedAvgAPI, get_algorithms
 
 
 def _select_algorithm(args):
@@ -27,10 +27,44 @@ def _select_algorithm(args):
     return algorithms[name]
 
 
+def _operator_kwargs(cls, client_trainer, server_aggregator) -> dict:
+    """L3 operator seam passthrough (core/frame.py): the FedAvg-family
+    engines consume custom operators; algorithms whose constructors do
+    not plumb the seam (SplitNN, VFL, defenses, gossip, ...) have
+    structurally different operator boundaries and reject custom
+    operators explicitly rather than ignoring them or TypeError-ing."""
+    if client_trainer is None and server_aggregator is None:
+        return {}
+    import inspect
+
+    sig_params = inspect.signature(cls.__init__).parameters
+    accepts = "client_trainer" in sig_params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in sig_params.values()
+    )
+    if not (issubclass(cls, FedAvgAPI) and accepts):
+        raise ValueError(
+            f"custom client_trainer/server_aggregator is not supported by "
+            f"{cls.__name__}; supported by the FedAvg family "
+            "(FedAvg/FedProx/FedOpt/FedNova/HierFedAvg)"
+        )
+    return {
+        "client_trainer": client_trainer,
+        "server_aggregator": server_aggregator,
+    }
+
+
 class SimulatorSingleProcess:
-    def __init__(self, args, device, dataset, model) -> None:
+    def __init__(
+        self, args, device, dataset, model, client_trainer=None, server_aggregator=None
+    ) -> None:
         cls = _select_algorithm(args)
-        self.fl_trainer = cls(args, device, dataset, model)
+        self.fl_trainer = cls(
+            args,
+            device,
+            dataset,
+            model,
+            **_operator_kwargs(cls, client_trainer, server_aggregator),
+        )
 
     def run(self):
         return self.fl_trainer.train()
@@ -39,7 +73,16 @@ class SimulatorSingleProcess:
 class SimulatorMesh:
     """Client-parallel FL over a device mesh."""
 
-    def __init__(self, args, device, dataset, model, mesh=None) -> None:
+    def __init__(
+        self,
+        args,
+        device,
+        dataset,
+        model,
+        mesh=None,
+        client_trainer=None,
+        server_aggregator=None,
+    ) -> None:
         self.mesh = mesh if mesh is not None else build_mesh(
             mesh_shape=getattr(args, "mesh_shape", None)
         )
@@ -68,7 +111,14 @@ class SimulatorMesh:
                 f"{cls.__name__} does not support the MESH backend yet; "
                 "run it under the single-process simulator"
             )
-        self.fl_trainer = cls(args, device, dataset, model, mesh=self.mesh)
+        self.fl_trainer = cls(
+            args,
+            device,
+            dataset,
+            model,
+            mesh=self.mesh,
+            **_operator_kwargs(cls, client_trainer, server_aggregator),
+        )
         self.fl_trainer.global_params = replicate(
             self.fl_trainer.global_params, self.mesh
         )

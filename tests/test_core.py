@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from fedml_tpu.core.aggregation import (
     RobustAggregator,
     normalize_weights,

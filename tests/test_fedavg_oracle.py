@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 import fedml_tpu
 from fedml_tpu import models
 from fedml_tpu.data import load

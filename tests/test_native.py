@@ -7,6 +7,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from fedml_tpu.core.native import exact_makespan, lpt_makespan_native
 from fedml_tpu.core.scheduler import best_makespan, greedy_makespan
 

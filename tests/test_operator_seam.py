@@ -1,0 +1,274 @@
+"""L3 operator seam: custom ClientTrainer / ServerAggregator plug into
+every scenario (reference extension point,
+``core/alg_frame/client_trainer.py:4-40`` — users subclass the operator
+pair and hand it to the runner).
+
+Assertions:
+- DefaultClientTrainer reproduces the stock engine exactly (it IS the
+  stock engine, factored through the seam);
+- a behavior-changing custom trainer changes training under BOTH the SP
+  simulator and cross-silo — one subclass, every backend;
+- a custom server aggregator changes aggregation under both.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.core.frame import (
+    ClientTrainer,
+    DefaultClientTrainer,
+    DefaultServerAggregator,
+    ServerAggregator,
+)
+from fedml_tpu.data import load
+from fedml_tpu.simulation import FedAvgAPI
+from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+
+pytestmark = pytest.mark.smoke
+
+
+def _mk(make, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=400,
+        synthetic_test_size=80,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+class FrozenTrainer(DefaultClientTrainer):
+    """Degenerate custom operator: local training is a no-op, so the
+    global model can never move — unambiguous evidence the engine is
+    running the custom fn."""
+
+    def make_train_fn(self, args):
+        inner = super().make_train_fn(args)
+
+        def train(params, batches, rng):
+            _, metrics = inner(params, batches, rng)
+            return params, metrics
+
+        return train
+
+
+class HalfStepTrainer(DefaultClientTrainer):
+    """Halve the local delta — a real behavior change with nontrivial
+    dynamics (equivalent to halving the effective client lr)."""
+
+    def make_train_fn(self, args):
+        inner = super().make_train_fn(args)
+
+        def train(params, batches, rng):
+            new, metrics = inner(params, batches, rng)
+            half = jax.tree.map(lambda n, p: p + 0.5 * (n - p), new, params)
+            return half, metrics
+
+        return train
+
+
+class GlobalKeepAggregator(DefaultServerAggregator):
+    """Ignore client updates entirely — server side analog of Frozen."""
+
+    def aggregate(self, global_params, stacked_params, weights, rng):
+        return global_params
+
+
+def _params_equal(a, b, atol=0.0):
+    flat_a, flat_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y), atol=atol)
+        for x, y in zip(flat_a, flat_b)
+    )
+
+
+def _sp_run(args_factory, client_trainer=None, server_aggregator=None, **kw):
+    args = _mk(args_factory, **kw)
+    args = fedml_tpu.init(args)
+    ds = load(args)
+    model = models.create(args, ds.class_num)
+    if client_trainer is not None:
+        client_trainer = client_trainer(model, args)
+    if server_aggregator is not None:
+        server_aggregator = server_aggregator(model, args)
+    sim = SimulatorSingleProcess(
+        args, None, ds, model,
+        client_trainer=client_trainer, server_aggregator=server_aggregator,
+    )
+    sim.run()
+    return sim.fl_trainer
+
+
+class TestSimulationSeam:
+    def test_default_trainer_is_stock_engine(self, args_factory):
+        stock = _sp_run(args_factory)
+        via_seam = _sp_run(args_factory, client_trainer=DefaultClientTrainer)
+        assert _params_equal(stock.global_params, via_seam.global_params, atol=1e-6)
+
+    def test_frozen_trainer_freezes_global_model(self, args_factory):
+        api = _sp_run(args_factory, client_trainer=FrozenTrainer)
+        init_params = api.model.init(
+            jax.random.split(jax.random.PRNGKey(0))[1]
+        )
+        assert _params_equal(init_params, api.global_params)
+
+    def test_halfstep_trainer_changes_training(self, args_factory):
+        stock = _sp_run(args_factory)
+        half = _sp_run(args_factory, client_trainer=HalfStepTrainer)
+        assert not _params_equal(stock.global_params, half.global_params, atol=1e-6)
+        # and it still trains (moves away from init)
+        init_params = half.model.init(jax.random.split(jax.random.PRNGKey(0))[1])
+        assert not _params_equal(init_params, half.global_params, atol=1e-6)
+
+    def test_custom_aggregator_keeps_global(self, args_factory):
+        api = _sp_run(args_factory, server_aggregator=GlobalKeepAggregator)
+        init_params = api.model.init(jax.random.split(jax.random.PRNGKey(0))[1])
+        assert _params_equal(init_params, api.global_params)
+
+    def test_non_fedavg_family_rejects_operators(self, args_factory):
+        args = _mk(args_factory, federated_optimizer="SplitNN")
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        with pytest.raises(ValueError, match="not supported"):
+            SimulatorSingleProcess(
+                args, None, ds, model,
+                client_trainer=DefaultClientTrainer(model, args),
+            )
+
+    def test_subclass_without_seam_rejects_not_typeerrors(self, args_factory):
+        """FedAvgAPI subclasses whose __init__ never plumbed the seam
+        (defenses, gossip) must raise the clear ValueError, not a
+        TypeError from an unexpected kwarg."""
+        args = _mk(args_factory, federated_optimizer="DSGD")
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        with pytest.raises(ValueError, match="not supported"):
+            SimulatorSingleProcess(
+                args, None, ds, model,
+                client_trainer=DefaultClientTrainer(model, args),
+            )
+
+    def test_fedopt_rejects_custom_aggregator(self, args_factory):
+        """FedOpt's server step IS the algorithm — a custom aggregator
+        would be silently dropped, so it must be rejected."""
+        args = _mk(args_factory, federated_optimizer="FedOpt")
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        with pytest.raises(ValueError, match="its own server aggregation"):
+            SimulatorSingleProcess(
+                args, None, ds, model,
+                server_aggregator=GlobalKeepAggregator(model, args),
+            )
+
+    def test_imperative_train_advances_rng_per_call(self, args_factory):
+        """Round N and round N+1 must not replay the same shuffle."""
+        args = _mk(args_factory, epochs=2, shuffle=True)
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        t1 = DefaultClientTrainer(model, args)
+        t2 = DefaultClientTrainer(model, args)
+        params = model.init(jax.random.PRNGKey(0))
+        batches = ds.train_data_local_dict[0]
+        t1.set_model_params(params)
+        r1 = t1.train(batches)  # call #1
+        t2.set_model_params(params)
+        t2.train(batches)  # burn call #1
+        t2.set_model_params(params)  # reset to the same start
+        r2 = t2.train(batches)  # call #2, identical inputs except rng
+        assert not _params_equal(r1, r2, atol=1e-7)
+
+
+class TestCrossSiloSeam:
+    def _run_world(self, args_factory, run_id, client_trainer_cls=None):
+        from fedml_tpu.cross_silo import Client, Server
+
+        def make(rank):
+            a = _mk(args_factory, training_type="cross_silo", backend="LOCAL")
+            a.run_id = run_id
+            a.rank = rank
+            a = fedml_tpu.init(a)
+            ds = load(a)
+            m = models.create(a, ds.class_num)
+            return a, ds, m
+
+        a0, ds0, m0 = make(0)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, 5):
+            a, ds, m = make(r)
+            ct = client_trainer_cls(m, a) if client_trainer_cls else None
+            clients.append(Client(a, None, ds, m, client_trainer=ct))
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        return server
+
+    def test_frozen_trainer_freezes_cross_silo(self, args_factory):
+        server = self._run_world(
+            args_factory, "seam_frozen", client_trainer_cls=FrozenTrainer
+        )
+        a = _mk(args_factory, training_type="cross_silo")
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        model = models.create(a, ds.class_num)
+        init_params = model.init(jax.random.split(jax.random.PRNGKey(0))[1])
+        assert _params_equal(init_params, server.aggregator.get_global_model_params())
+
+    def test_custom_trainer_matches_simulation(self, args_factory):
+        """Same custom operator, two backends, same numbers — the seam
+        composes with the transport the way the stock engine does."""
+        server = self._run_world(
+            args_factory, "seam_half", client_trainer_cls=HalfStepTrainer
+        )
+        sim = _sp_run(args_factory, client_trainer=HalfStepTrainer)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            server.aggregator.get_global_model_params(),
+            sim.global_params,
+        )
+
+
+class TestImperativeSurface:
+    """Reference-parity surface: get/set params + train(data) works."""
+
+    def test_imperative_train(self, args_factory):
+        args = _mk(args_factory)
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        trainer = DefaultClientTrainer(model, args)
+        trainer.set_id(2)
+        params = model.init(jax.random.PRNGKey(0))
+        trainer.set_model_params(params)
+        batches = ds.train_data_local_dict[0]
+        new = trainer.train(batches)
+        assert not _params_equal(params, new, atol=1e-7)
+        assert _params_equal(trainer.get_model_params(), new)
+        stats = trainer.test(ds.test_data_local_dict[0])
+        assert "acc" in stats and "loss" in stats
