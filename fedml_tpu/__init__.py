@@ -52,6 +52,15 @@ def init(args: Optional[Arguments] = None) -> Arguments:
         args.process_id = 0
     elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_SILO:
         args.process_id = int(getattr(args, "rank", 0))
+        if getattr(args, "distributed_coordinator", None):
+            # multi-controller hierarchical silo: join the runtime's
+            # process group BEFORE anything initializes the backend
+            # (the torchrun-env analog, reference __init__.py:85-130)
+            from .cross_silo.hierarchical.process_group_manager import (
+                ensure_distributed_initialized,
+            )
+
+            ensure_distributed_initialized(args)
     elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
         args.rank = 0
         args.process_id = 0

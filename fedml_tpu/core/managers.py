@@ -27,16 +27,10 @@ def _build_com_manager(
         fabric = f"run_{getattr(args, 'run_id', '0')}"
         return LocalCommunicationManager(fabric, rank, size)
     if backend == constants.COMM_BACKEND_GRPC:
-        from .comm.grpc_backend import GrpcCommunicationManager
-
-        ip_config = None
-        path = getattr(args, "grpc_ipconfig_path", None)
-        if path:
-            ip_config = _load_ip_config(path)
-        return GrpcCommunicationManager(
-            rank=rank,
-            size=size,
-            ip_config=ip_config,
+        return build_grpc_manager(
+            rank,
+            size,
+            ipconfig_path=getattr(args, "grpc_ipconfig_path", None),
             port_base=int(getattr(args, "grpc_port_base", 8890)),
         )
     if backend in (constants.COMM_BACKEND_MQTT, constants.COMM_BACKEND_MQTT_S3):
@@ -59,6 +53,19 @@ def _build_com_manager(
         store = FilePayloadStore(getattr(args, "payload_store_dir", None))
         return HybridCommunicationManager(control, store)
     raise ValueError(f"unsupported comm backend {backend!r}")
+
+
+def build_grpc_manager(
+    rank: int, size: int, ipconfig_path: Optional[str], port_base: int
+):
+    """Shared gRPC endpoint builder — used for the FL world and for
+    silo control fabrics (cross_silo/hierarchical)."""
+    from .comm.grpc_backend import GrpcCommunicationManager
+
+    ip_config = _load_ip_config(ipconfig_path) if ipconfig_path else None
+    return GrpcCommunicationManager(
+        rank=rank, size=size, ip_config=ip_config, port_base=port_base
+    )
 
 
 def _load_ip_config(path: str) -> Dict[int, str]:

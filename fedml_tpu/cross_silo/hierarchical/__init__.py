@@ -29,7 +29,13 @@ from __future__ import annotations
 
 from .client_master_manager import ClientMasterManager
 from .client_slave_manager import ClientSlaveManager
-from .process_group_manager import ProcessGroupManager, silo_fabric_name
+from .launcher import launch_silo_processes
+from .process_group_manager import (
+    ProcessGroupManager,
+    build_silo_fabric,
+    ensure_distributed_initialized,
+    silo_fabric_name,
+)
 from .trainer_dist_adapter import TrainerDistAdapter
 
 __all__ = [
@@ -38,6 +44,9 @@ __all__ = [
     "ProcessGroupManager",
     "TrainerDistAdapter",
     "HierarchicalClient",
+    "build_silo_fabric",
+    "ensure_distributed_initialized",
+    "launch_silo_processes",
     "silo_fabric_name",
 ]
 

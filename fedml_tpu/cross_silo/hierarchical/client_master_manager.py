@@ -5,16 +5,20 @@ the rank-0 process of a silo speaks the horizontal 3-message FedAvg
 protocol to the server, and before every local round broadcasts
 ``[round_idx, params, client_index]`` to the silo's slave processes
 (``sync_process_group`` :239-249 uses ``dist.broadcast_object_list``;
-here the triple is a message on the silo-private control fabric). On
-FINISH the master relays a silo-finish so slaves exit their loops.
+here the triple is a message on the silo-private control fabric — see
+``process_group_manager.build_silo_fabric``: in-process queues for
+thread silos, gRPC for one-OS-process-per-host silos). On FINISH the
+master relays a silo-finish so slaves exit their loops.
 """
 
 from __future__ import annotations
 
 import logging
 
+import jax
+import numpy as np
+
 from ... import constants
-from ...core.comm.local import LocalCommunicationManager
 from ...core.message import Message
 from ..horizontal.fedml_client_manager import FedMLClientManager
 
@@ -24,16 +28,18 @@ class ClientMasterManager(FedMLClientManager):
         super().__init__(args, trainer, **kw)
         self.pg = process_group
         # control fabric: master is silo-rank 0, slaves 1..n-1
-        self._silo_com = LocalCommunicationManager(
-            self.pg.fabric_name, 0, self.pg.n_proc_in_silo
-        )
+        self._silo_com = self.pg.build_fabric()
 
     def sync_process_group(self, round_idx, params, client_index) -> None:
         """(client_master_manager.py:239-249)"""
+        if self.pg.n_proc_in_silo <= 1:
+            return
+        # networked fabrics serialize; ship host arrays, not jax buffers
+        host_params = jax.tree.map(np.asarray, params) if _is_jax_tree(params) else params
         for slave in self.pg.slave_ranks():
             msg = Message(constants.MSG_TYPE_SILO_SYNC_PROCESS_GROUP, 0, slave)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
-            msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, params)
+            msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, host_params)
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, client_index)
             self._silo_com.send_message(msg)
 
@@ -50,5 +56,16 @@ class ClientMasterManager(FedMLClientManager):
                 Message(constants.MSG_TYPE_SILO_FINISH, 0, slave)
             )
         logging.info("silo master rank %d: finish", self.rank)
+        # release fabric resources (gRPC server/channels); for LOCAL,
+        # drop the process-global fabric so a later run reusing this
+        # run_id starts with fresh inboxes (no stale _STOP sentinels)
+        self._silo_com.stop_receive_message()
+        if hasattr(self._silo_com, "destroy_fabric"):
+            self._silo_com.destroy_fabric()
         super().handle_message_finish(msg)
         self.pg.cleanup()
+
+
+def _is_jax_tree(tree) -> bool:
+    leaves = jax.tree.leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.Array)

@@ -4,6 +4,11 @@ Parity with ``cross_silo/hierarchical/client_slave_manager.py:5-54``
 (``await_sync_process_group`` :39-50 blocks on the rank-0 broadcast,
 then trains). The slave never talks to the FL server — its whole world
 is the silo-private control fabric plus the silo's SPMD computation.
+
+Transport-agnostic: the slave is an Observer on whatever fabric
+``args.silo_backend`` selects (in-process queues for thread silos, gRPC
+for one-OS-process-per-host silos), blocking in the fabric's own
+receive loop rather than reaching into a queue implementation.
 """
 
 from __future__ import annotations
@@ -11,36 +16,37 @@ from __future__ import annotations
 import logging
 
 from ... import constants
-from ...core.comm.local import LocalCommunicationManager
+from ...core.comm.base import Observer
 from ...core.message import Message
 
 
-class ClientSlaveManager:
+class ClientSlaveManager(Observer):
     def __init__(self, args, trainer, process_group) -> None:
         self.args = args
         self.trainer = trainer
         self.pg = process_group
-        self._com = LocalCommunicationManager(
-            self.pg.fabric_name, self.pg.proc_rank_in_silo, self.pg.n_proc_in_silo
-        )
-        self._finished = False
+        self._com = self.pg.build_fabric()
+        self._com.add_observer(self)
 
-    def await_sync_process_group(self) -> None:
-        """(client_slave_manager.py:39-50)"""
-        inbox = self._com.fabric.inbox(self.pg.proc_rank_in_silo)
-        msg = inbox.get()
-        if not isinstance(msg, Message) or msg.get_type() == constants.MSG_TYPE_SILO_FINISH:
-            self._finished = True
-            return
-        round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
-        params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
-        client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
-        self.trainer.update_dataset(int(client_index))
-        self.trainer.participate(params, round_idx)
+    def receive_message(self, msg_type, msg: Message) -> None:
+        """(client_slave_manager.py:39-50 await_sync_process_group)"""
+        if msg_type == constants.MSG_TYPE_SILO_SYNC_PROCESS_GROUP:
+            round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
+            params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+            client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
+            self.trainer.update_dataset(int(client_index))
+            self.trainer.participate(params, round_idx)
+        elif msg_type == constants.MSG_TYPE_SILO_FINISH:
+            self._com.stop_receive_message()
+        else:
+            logging.warning("silo slave: unexpected msg_type %s", msg_type)
 
     def run(self) -> None:
-        while not self._finished:
-            self.await_sync_process_group()
+        self._com.handle_receive_message()  # blocks until SILO_FINISH
+        if hasattr(self._com, "destroy_fabric"):
+            # LOCAL fabrics are process-global; drop so a later run
+            # reusing this run_id doesn't inherit stale sentinels
+            self._com.destroy_fabric()
         logging.info(
             "silo slave %d/%d: finish",
             self.pg.proc_rank_in_silo,

@@ -1,19 +1,69 @@
-"""Silo process-group bookkeeping.
+"""Silo process-group bookkeeping + silo control-fabric dispatch.
 
 TPU analog of ``cross_silo/hierarchical/process_group_manager.py:6-43``:
 the reference calls ``dist.init_process_group`` (NCCL/GLOO) plus a
 second ``new_group()`` for control messaging. Here the compute group is
 the JAX runtime itself — for multi-host silos,
 ``jax.distributed.initialize`` (the runtime's own process group) is
-invoked once; collectives then ride ICI/DCN under jit with no backend
-objects to manage. The control group is a silo-private message fabric
-(in-process queues or any configured transport) carrying the
-master->slave round broadcast.
+invoked once per process; collectives then ride ICI/DCN under jit with
+no backend objects to manage. The control group is a silo-private
+message fabric selected by ``args.silo_backend``:
+
+- ``LOCAL`` (default): in-process queues — valid only when every silo
+  actor is a thread of ONE process (the test/sim configuration);
+- ``GRPC``: rank-addressed gRPC on ``args.silo_grpc_port_base + rank``
+  — the real multi-controller path, one OS process per host, the
+  counterpart of the reference's torchrun rendezvous + second gloo
+  group (``dist_trainer_launcher.py:23-48``).
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+
+_dist_lock = threading.Lock()
+_dist_initialized = False
+
+
+def ensure_distributed_initialized(args) -> bool:
+    """Join the JAX runtime's process group (idempotent).
+
+    MUST run before anything touches the backend (first ``jax.devices()``
+    / array creation), which is why ``fedml_tpu.init()`` calls this as
+    its first JAX-touching act for multi-controller cross-silo runs —
+    the analog of the reference initializing torch.distributed from
+    torchrun env before building trainers (``fedml/__init__.py:85-130``).
+    Returns True when this run is multi-controller."""
+    global _dist_initialized
+    coordinator = getattr(args, "distributed_coordinator", None)
+    n_proc = int(getattr(args, "n_proc_in_silo", 1) or 1)
+    if not coordinator:
+        return False
+    if n_proc <= 1:
+        # fail loudly: a coordinator with a 1-process group is always a
+        # misconfiguration (the other host would hang as an orphan slave)
+        raise ValueError(
+            "distributed_coordinator is set but n_proc_in_silo is "
+            f"{n_proc}; a multi-controller silo needs n_proc_in_silo >= 2"
+        )
+    with _dist_lock:
+        if not _dist_initialized:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=n_proc,
+                process_id=int(getattr(args, "proc_rank_in_silo", 0) or 0),
+            )
+            _dist_initialized = True
+            logging.info(
+                "jax.distributed: joined %s as process %s/%d",
+                coordinator,
+                getattr(args, "proc_rank_in_silo", 0),
+                n_proc,
+            )
+    return True
 
 
 def silo_fabric_name(args) -> str:
@@ -23,14 +73,43 @@ def silo_fabric_name(args) -> str:
     return f"hier_{run_id}_silo{silo}"
 
 
+def build_silo_fabric(args, rank: int, size: int):
+    """Control-fabric dispatch for the master->slave round broadcast
+    (the reference's second ``new_group()`` for control messaging,
+    process_group_manager.py:30-34). Ranks are silo-process ranks
+    0..size-1 (0 = master)."""
+    backend = str(getattr(args, "silo_backend", "LOCAL") or "LOCAL").upper()
+    if backend == "LOCAL":
+        from ...core.comm.local import LocalCommunicationManager
+
+        return LocalCommunicationManager(silo_fabric_name(args), rank, size)
+    if backend == "GRPC":
+        from ...core.managers import build_grpc_manager
+
+        # per-silo port block: silo k (FL rank k, 1-based) owns
+        # [base + (k-1)*size, base + k*size) so co-hosted silos don't
+        # collide — the port-space analog of silo_fabric_name
+        base = int(getattr(args, "silo_grpc_port_base", 9890))
+        silo = max(int(getattr(args, "rank", 1)), 1)
+        return build_grpc_manager(
+            rank,
+            size,
+            ipconfig_path=getattr(args, "silo_grpc_ipconfig_path", None),
+            port_base=base + (silo - 1) * size,
+        )
+    raise ValueError(f"unsupported silo_backend {backend!r}")
+
+
 class ProcessGroupManager:
     """Identity + lifecycle of one process inside a silo.
 
     ``n_proc_in_silo`` / ``proc_rank_in_silo`` mirror the reference's
     torchrun-derived env (``fedml/__init__.py:85-130``). When
     ``args.distributed_coordinator`` is set this is a multi-controller
-    run: each silo process is a JAX host process and we join the
-    runtime's process group (``jax.distributed.initialize``).
+    run: each silo process is a JAX host process and joins the
+    runtime's process group (``jax.distributed.initialize`` — normally
+    already done by ``fedml_tpu.init()``; the call here is the
+    idempotent safety net for directly-constructed managers).
     """
 
     def __init__(self, args) -> None:
@@ -38,22 +117,7 @@ class ProcessGroupManager:
         self.n_proc_in_silo = int(getattr(args, "n_proc_in_silo", 1) or 1)
         self.proc_rank_in_silo = int(getattr(args, "proc_rank_in_silo", 0) or 0)
         self.fabric_name = silo_fabric_name(args)
-        coordinator = getattr(args, "distributed_coordinator", None)
-        self.multi_controller = bool(coordinator)
-        if self.multi_controller:
-            import jax
-
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=self.n_proc_in_silo,
-                process_id=self.proc_rank_in_silo,
-            )
-            logging.info(
-                "silo process group: joined %s as %d/%d",
-                coordinator,
-                self.proc_rank_in_silo,
-                self.n_proc_in_silo,
-            )
+        self.multi_controller = ensure_distributed_initialized(args)
 
     def is_master(self) -> bool:
         return self.proc_rank_in_silo == 0
@@ -61,8 +125,16 @@ class ProcessGroupManager:
     def slave_ranks(self):
         return range(1, self.n_proc_in_silo)
 
-    def cleanup(self) -> None:
-        if self.multi_controller:
-            import jax
+    def build_fabric(self):
+        """This process's endpoint on the silo control fabric."""
+        return build_silo_fabric(self.args, self.proc_rank_in_silo, self.n_proc_in_silo)
 
-            jax.distributed.shutdown()
+    def cleanup(self) -> None:
+        global _dist_initialized
+        if self.multi_controller:
+            with _dist_lock:
+                if _dist_initialized:
+                    import jax
+
+                    jax.distributed.shutdown()
+                    _dist_initialized = False

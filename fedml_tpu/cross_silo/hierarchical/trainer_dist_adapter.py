@@ -119,28 +119,45 @@ class TrainerDistAdapter:
     def update_dataset(self, client_index: int) -> None:
         self.client_index = int(client_index)
 
+    def _put(self, a, sharding):
+        """Host array -> global device array on the silo mesh. Under a
+        single controller ``device_put`` suffices; under multi-controller
+        every process holds the full host copy (same seed -> same data,
+        same params off the control fabric) and
+        ``make_array_from_callback`` hands each process exactly the
+        shards it is responsible for — the assembly step the reference
+        gets from DDP scattering per-rank loaders."""
+        if not self.pg.multi_controller:
+            return jax.device_put(a, sharding)
+        host = np.asarray(a)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, _h=host: _h[idx]
+        )
+
     def _silo_batch(self) -> Batches:
         i = self.client_index
         packed = self.dataset.packed_train
         client = Batches(x=packed.x[i], y=packed.y[i], mask=packed.mask[i])
-        if self.pg.multi_controller:
-            # every silo process holds the full host copy; build the
-            # global sharded array from per-process data
-            put = lambda a: jax.make_array_from_process_local_data(
-                self._batch_sharding, np.asarray(a)
-            )
-        else:
-            put = lambda a: jax.device_put(a, self._batch_sharding)
+        put = lambda a: self._put(a, self._batch_sharding)
         return Batches(x=put(client.x), y=put(client.y), mask=put(client.mask))
 
     def train(self, params, round_idx: int):
         i = self.client_index
-        params = jax.device_put(params, self._replicated)
+        params = jax.tree.map(lambda a: self._put(a, self._replicated), params)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
             round_idx * 100003 + i,
         )
+        if self.pg.multi_controller:
+            # uncommitted host value: identical on every process, so the
+            # jit treats it as consistently replicated
+            rng = np.asarray(rng)
         new_params, _metrics = self._fn(params, self._silo_batch(), rng)
+        if self.pg.multi_controller:
+            # fully-replicated global arrays -> host copies, so the FL
+            # message layer (and the server's single-device aggregation)
+            # never sees cross-process buffers
+            new_params = jax.tree.map(np.asarray, new_params)
         n = float(self.dataset.packed_num_samples[i])
         return new_params, n
 

@@ -1,0 +1,102 @@
+"""One OS process of a multi-controller hierarchical silo (test worker).
+
+Spawned by ``tests/test_multiprocess_hierarchical.py`` via
+``fedml_tpu.cross_silo.hierarchical.launch_silo_processes`` — the analog
+of the reference's per-node torchrun entry
+(``dist_trainer_launcher.py:23-48`` -> ``torch_client.py``). Process 0
+hosts the FL server (LOCAL fabric, same process) AND the silo master;
+process 1+ are silo slaves reachable only over the gRPC control fabric.
+"""
+
+import argparse
+import sys
+import threading
+
+
+def build_args(ns, rank: int):
+    from fedml_tpu.arguments import Arguments
+
+    args = Arguments()
+    cfg = dict(
+        training_type="cross_silo",
+        scenario="hierarchical",
+        backend="LOCAL",
+        dataset="mnist",
+        synthetic_train_size=256,
+        synthetic_test_size=64,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=2,
+        client_num_per_round=1,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+        run_id="mp_hier",
+        rank=rank,
+        n_proc_in_silo=ns.n_proc_in_silo,
+        proc_rank_in_silo=ns.proc_rank_in_silo,
+        distributed_coordinator=ns.distributed_coordinator,
+        silo_backend="GRPC",
+        silo_grpc_port_base=ns.silo_grpc_port_base,
+    )
+    for k, v in cfg.items():
+        setattr(args, k, v)
+    args._validate()
+    return args
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--proc_rank_in_silo", type=int, required=True)
+    p.add_argument("--n_proc_in_silo", type=int, required=True)
+    p.add_argument("--distributed_coordinator", required=True)
+    p.add_argument("--silo_grpc_port_base", type=int, required=True)
+    p.add_argument("--out", default="")
+    ns = p.parse_args()
+
+    import fedml_tpu
+
+    # client args: FL rank 1 (the silo). init() joins jax.distributed
+    # BEFORE the backend is touched.
+    args = fedml_tpu.init(build_args(ns, rank=1))
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu import models
+    from fedml_tpu.cross_silo.hierarchical import HierarchicalClient
+    from fedml_tpu.data import load
+
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == ns.n_proc_in_silo
+
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    client = HierarchicalClient(args, None, dataset, model)
+
+    if ns.proc_rank_in_silo == 0:
+        from fedml_tpu.cross_silo import Server
+
+        srv_args = build_args(ns, rank=0)
+        srv_args.training_type = "cross_silo"
+        server = Server(srv_args, None, dataset, model)
+        t = threading.Thread(target=client.run, daemon=True)
+        t.start()
+        server.run()
+        t.join(timeout=180)
+        assert not t.is_alive(), "master client thread hung"
+        params = server.aggregator.get_global_model_params()
+        flat = {f"p{i}": np.asarray(x) for i, x in enumerate(jax.tree.leaves(params))}
+        np.savez(ns.out, **flat)
+        print("MASTER_DONE", flush=True)
+    else:
+        client.run()
+        print("SLAVE_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
