@@ -24,6 +24,7 @@ FEDML_CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
 # Communication backends (reference: client_manager.py:27-94 dispatch table).
 COMM_BACKEND_LOCAL = "LOCAL"  # in-process queues (tests / single host)
 COMM_BACKEND_GRPC = "GRPC"
+COMM_BACKEND_TRPC = "TRPC"  # persistent-pipe raw-tensor RPC (TensorPipe analog)
 COMM_BACKEND_MPI = "MPI"  # accepted; mapped onto the LOCAL/GRPC transports
 COMM_BACKEND_MQTT = "MQTT"
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"

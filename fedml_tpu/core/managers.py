@@ -33,6 +33,23 @@ def _build_com_manager(
             ipconfig_path=getattr(args, "grpc_ipconfig_path", None),
             port_base=int(getattr(args, "grpc_port_base", 8890)),
         )
+    if backend == constants.COMM_BACKEND_TRPC:
+        from .comm.tensor_rpc import TensorRpcCommunicationManager
+
+        # fall back to the grpc_* keys symmetrically (path AND port) so
+        # flipping backend GRPC->TRPC on an existing config just works
+        path = getattr(args, "trpc_ipconfig_path", None) or getattr(
+            args, "grpc_ipconfig_path", None
+        )
+        port_base = getattr(args, "trpc_port_base", None) or getattr(
+            args, "grpc_port_base", 8890
+        )
+        return TensorRpcCommunicationManager(
+            rank=rank,
+            size=size,
+            ip_config=_load_ip_config(path) if path else None,
+            port_base=int(port_base),
+        )
     if backend in (constants.COMM_BACKEND_MQTT, constants.COMM_BACKEND_MQTT_S3):
         from .comm.broker import broker_for_run, ensure_broker
         from .comm.mqtt_backend import MqttCommunicationManager
