@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .sockutil import recv_exact
+
 _HDR = struct.Struct(">I")
 _VERB_SUB, _VERB_PUB, _VERB_MSG = 0, 1, 2
 
@@ -49,25 +51,15 @@ def _decode_body(body: bytes) -> Tuple[int, str, bytes]:
     return verb, topic, body[3 + tlen :]
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
-
-
 def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, str, bytes]]:
-    hdr = _recv_exact(sock, _HDR.size)
+    hdr = recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
     (length,) = _HDR.unpack(hdr)
-    body = _recv_exact(sock, length)
+    body = recv_exact(sock, length)
     if body is None:
         return None
-    return _decode_body(body)
+    return _decode_body(bytes(body))
 
 
 class _LockedSock:

@@ -144,16 +144,7 @@ def decode_frame(header: bytes, body: memoryview) -> Message:
     return m
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            return None
-        got += r
-    return memoryview(buf)
+from .sockutil import recv_exact as _recv_exact  # shared exact-read helper
 
 
 class TensorRpcCommunicationManager(BaseCommunicationManager):
@@ -234,23 +225,47 @@ class TensorRpcCommunicationManager(BaseCommunicationManager):
     def _pipe(self, receiver: int) -> Tuple[socket.socket, threading.Lock]:
         with self._pipe_lock:
             s = self._pipes.get(receiver)
-            if s is None:
-                addr = (self.ip_config[receiver], self.port_base + receiver)
-                s = socket.create_connection(addr, timeout=300)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._pipes[receiver] = s
-                self._send_locks[receiver] = threading.Lock()
+            if s is not None:
+                return s, self._send_locks[receiver]
+        # connect OUTSIDE the table lock: a slow/unreachable receiver
+        # must not wedge sends to other ranks or shutdown
+        addr = (self.ip_config[receiver], self.port_base + receiver)
+        s = socket.create_connection(addr, timeout=300)
+        s.settimeout(None)  # connect timeout only; sends are blocking
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._pipe_lock:
+            # lost the race? keep the first pipe, drop ours
+            existing = self._pipes.get(receiver)
+            if existing is not None:
+                s.close()
+                return existing, self._send_locks[receiver]
+            self._pipes[receiver] = s
+            self._send_locks[receiver] = threading.Lock()
             return s, self._send_locks[receiver]
+
+    def _evict_pipe(self, receiver: int, pipe: socket.socket) -> None:
+        with self._pipe_lock:
+            if self._pipes.get(receiver) is pipe:
+                del self._pipes[receiver]
+        try:
+            pipe.close()
+        except OSError:
+            pass
 
     def send_message(self, msg: Message) -> None:
         receiver = int(msg.get_receiver_id())
         parts = encode_frame(msg)
         body_len = sum(len(p) for p in parts[1:])
         pipe, send_lock = self._pipe(receiver)
-        with send_lock:  # frame atomicity per pipe only
-            pipe.sendall(parts[0] + _LEN.pack(body_len))
-            for p in parts[1:]:
-                pipe.sendall(p)
+        try:
+            with send_lock:  # frame atomicity per pipe only
+                pipe.sendall(parts[0] + _LEN.pack(body_len))
+                for p in parts[1:]:
+                    pipe.sendall(p)
+        except OSError:
+            # a partially-written frame desyncs the pipe; never reuse it
+            self._evict_pipe(receiver, pipe)
+            raise
 
     # -- observer loop -------------------------------------------------
     def add_observer(self, observer: Observer) -> None:

@@ -9,10 +9,20 @@ test_data_local_dict, class_num]`` (data_loader.py:310-320) — plus the
 device-side packed federation (``packed_train`` / ``packed_test``,
 leaves ``[C, nb, bs, ...]``) that the TPU simulators consume.
 
-Dataset resolution: real files in ``args.data_cache_dir`` when present
-(LEAF-style .npz per split), otherwise a synthetic stand-in with the
-real dataset's shapes/classes (this environment has no egress; the
-reference downloads from S3, ``data/MNIST/data_loader.py:17-29``).
+Dataset resolution order under ``<data_cache_dir>/<dataset>/``:
+
+1. **naturally federated on-disk sources** — LEAF json split dirs
+   (``train/*.json``; reference ``data/MNIST/data_loader.py:30-99``)
+   and TFF h5 (``fed_cifar100_train.h5`` etc.; reference
+   ``data/fed_cifar100/data_loader.py``) — the per-user grouping IS the
+   partition, LDA is bypassed;
+2. **global on-disk sources** — CIFAR python batches
+   (``cifar-10-batches-py/``; reference ``cifar10/data_loader.py``) and
+   the generic ``{train,test}.npz`` drop-in — LDA/homo partition
+   applies;
+3. synthetic stand-in with the real dataset's shapes/classes (this
+   environment has no egress; the reference downloads from S3,
+   ``data/MNIST/data_loader.py:17-29``), with a loud warning.
 """
 
 from __future__ import annotations
@@ -91,13 +101,52 @@ class FederatedDataset:
 
 
 def _try_load_real(name: str, cache_dir: str):
-    """Real data drop-in: <cache>/<name>/{train,test}.npz with x,y."""
+    """Global real data: CIFAR python batches, else {train,test}.npz."""
     d = os.path.join(cache_dir or "", name)
+    if name in ("cifar10", "cifar100"):
+        from .ingest import cifar_batches_available, load_cifar_batches
+
+        if cifar_batches_available(d, name):
+            return load_cifar_batches(d, name)
     tr, te = os.path.join(d, "train.npz"), os.path.join(d, "test.npz")
     if os.path.exists(tr) and os.path.exists(te):
         a, b = np.load(tr), np.load(te)
         return (a["x"], a["y"], b["x"], b["y"])
     return None
+
+
+def _try_load_federated(name: str, cache_dir: str):
+    """Naturally-federated on-disk sources: LEAF json dirs, TFF h5.
+    Returns per-client (xs_tr, ys_tr, xs_te, ys_te) or None."""
+    if name not in _DATASET_META:
+        return None
+    d = os.path.join(cache_dir or "", name)
+    shape, _class_num, _, _, task = _DATASET_META[name]
+    from . import ingest
+    from .leaf import leaf_available, load_leaf
+
+    out = None
+    if leaf_available(d):
+        if task == "nwp":
+            # LEAF shakespeare stores raw strings with single-char
+            # targets — a different task shape than the per-token TFF
+            # pipeline; use the TFF h5 artifact for nwp datasets
+            logging.warning(
+                "dataset %s: LEAF json found but nwp ingestion uses the "
+                "TFF h5 artifact; ignoring the json dir", name,
+            )
+        else:
+            out = load_leaf(d, feature_shape=shape)
+    if out is None and ingest.tff_h5_available(d, name):
+        out = ingest.load_tff_h5(d, name)
+    if out is None:
+        return None
+    xs_tr, ys_tr, xs_te, ys_te = out
+    if task == "classification" and xs_tr and xs_tr[0].ndim == len(shape):
+        # h5 images stored [N,H,W] (fed_emnist 'pixels') -> add channel
+        xs_tr = [x.reshape(x.shape + (1,)) for x in xs_tr]
+        xs_te = [x.reshape(x.shape + (1,)) for x in xs_te]
+    return xs_tr, ys_tr, xs_te, ys_te
 
 
 def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, str]:
@@ -157,6 +206,28 @@ def load(args) -> FederatedDataset:
             k = max(1, int(0.8 * len(x)))
             xs_tr.append(x[:k]); ys_tr.append(y[:k])
             xs_te.append(x[k:]); ys_te.append(y[k:])
+    elif (
+        fed := _try_load_federated(name, getattr(args, "data_cache_dir", None))
+    ) is not None:
+        # naturally federated: the on-disk per-user split IS the
+        # partition (no LDA). Fold users onto the requested client
+        # count; cap the config when it asks for more clients than the
+        # dataset has users.
+        from .ingest import regroup_clients
+
+        _, class_num, _, _, task = _DATASET_META[name]
+        xs_tr, ys_tr, xs_te, ys_te = fed
+        n_users = len(xs_tr)
+        if client_num > n_users:
+            logging.warning(
+                "dataset %s has %d users < client_num_in_total=%d; capping",
+                name, n_users, client_num,
+            )
+            client_num = n_users
+            args.client_num_in_total = n_users
+            args.client_num_per_round = min(int(args.client_num_per_round), n_users)
+        xs_tr, ys_tr = regroup_clients(xs_tr, ys_tr, client_num)
+        xs_te, ys_te = regroup_clients(xs_te, ys_te, client_num)
     else:
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
         method = getattr(args, "partition_method", constants.PARTITION_HETERO)

@@ -1,0 +1,232 @@
+"""Real on-disk dataset ingestion (VERDICT r2 #4).
+
+- a REAL-format LEAF json split (checked into tests/data/mnist) flows
+  through ``load(args)`` end to end with NO synthetic stand-in warning;
+- TFF h5 (fed_cifar100 / fed_shakespeare shapes, reference
+  ``data/fed_cifar100/data_loader.py``) written by h5py in the
+  canonical layout loads as a natural federation;
+- CIFAR python batches (``cifar10/data_loader.py:106-120`` format) load
+  globally and LDA-partition;
+- user folding (regroup_clients) keeps any client_num runnable.
+"""
+
+import logging
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.data import load
+from fedml_tpu.data.ingest import (
+    SHAKESPEARE_VOCAB,
+    load_cifar_batches,
+    load_tff_h5,
+    regroup_clients,
+    shakespeare_to_sequences,
+)
+from fedml_tpu.simulation import FedAvgAPI
+
+pytestmark = pytest.mark.smoke
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def _args(make, **kw):
+    base = dict(
+        dataset="mnist",
+        model="lr",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=2,
+        epochs=1,
+        batch_size=8,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+class TestLeafJson:
+    def test_loads_real_leaf_no_synthetic_fallback(self, args_factory, caplog):
+        args = _args(args_factory, data_cache_dir=FIXTURES)
+        args = fedml_tpu.init(args)
+        with caplog.at_level(logging.WARNING):
+            ds = load(args)
+        assert "synthetic stand-in" not in caplog.text
+        # natural federation: 4 LEAF users, ragged sizes 10..13
+        assert ds.client_num == 4
+        assert sorted(ds.train_data_local_num_dict.values()) == [10, 11, 12, 13]
+        assert ds.class_num == 10
+        assert ds.packed_train.x.shape[-3:] == (8, 28, 28) or ds.packed_train.x.shape[-4:-1] == (8, 28, 28)
+
+    def test_trains_end_to_end(self, args_factory):
+        args = _args(args_factory, data_cache_dir=FIXTURES)
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        api = FedAvgAPI(args, None, ds, model)
+        stats = api.train()
+        assert np.isfinite(stats["train_loss"])
+
+    def test_user_folding_when_fewer_clients_requested(self, args_factory):
+        args = _args(
+            args_factory, data_cache_dir=FIXTURES,
+            client_num_in_total=2, client_num_per_round=2,
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 2
+        # all 46 samples survive the fold
+        assert sum(ds.train_data_local_num_dict.values()) == 46
+
+    def test_caps_when_more_clients_requested(self, args_factory):
+        args = _args(
+            args_factory, data_cache_dir=FIXTURES,
+            client_num_in_total=9, client_num_per_round=9,
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 4
+        assert args.client_num_in_total == 4
+        assert args.client_num_per_round == 4
+
+
+def _write_tff_cifar100(dirpath, n_clients=3):
+    import h5py
+
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for split, n_img in (("train", 10), ("test", 4)):
+        with h5py.File(os.path.join(dirpath, f"fed_cifar100_{split}.h5"), "w") as f:
+            g = f.create_group("examples")
+            for c in range(n_clients):
+                cg = g.create_group(f"client_{c}")
+                cg.create_dataset(
+                    "image", data=rng.randint(0, 256, (n_img, 32, 32, 3), np.uint8)
+                )
+                cg.create_dataset(
+                    "label", data=rng.randint(0, 100, (n_img, 1), np.int64)
+                )
+
+
+class TestTffH5:
+    def test_fed_cifar100_loads(self, tmp_path, args_factory):
+        d = tmp_path / "fed_cifar100"
+        _write_tff_cifar100(str(d))
+        args = _args(
+            args_factory,
+            dataset="fed_cifar100",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=3,
+            client_num_per_round=3,
+            model="cnn",
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 3
+        assert ds.class_num == 100
+        assert ds.packed_train.x.shape[-3:] == (32, 32, 3)
+        # [0,1] scaling applied
+        assert float(ds.packed_train.x.max()) <= 1.0
+
+    def test_fed_shakespeare_loads(self, tmp_path, args_factory):
+        import h5py
+
+        d = tmp_path / "fed_shakespeare"
+        os.makedirs(d)
+        lines = [
+            b"To be, or not to be, that is the question:",
+            b"Whether 'tis nobler in the mind to suffer",
+            b"The slings and arrows of outrageous fortune,",
+        ]
+        for split, k in (("train", 3), ("test", 1)):
+            with h5py.File(os.path.join(d, f"shakespeare_{split}.h5"), "w") as f:
+                g = f.create_group("examples")
+                for c in range(2):
+                    cg = g.create_group(f"bard_{c}")
+                    cg.create_dataset("snippets", data=lines[:k])
+        args = _args(
+            args_factory,
+            dataset="fed_shakespeare",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=2,
+            client_num_per_round=2,
+            model="rnn",
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 2
+        assert ds.task == "nwp"
+        assert ds.packed_train.x.shape[-1] == 80
+        assert ds.packed_train.x.dtype == np.int32
+
+
+class TestShakespearePreprocess:
+    def test_windows_and_specials(self):
+        x, y = shakespeare_to_sequences(["ab"])
+        assert x.shape == (1, 80) and y.shape == (1, 80)
+        # y is x shifted by one: tokens are [bos a b eos pad...]
+        assert y[0, 0] == x[0, 1]
+        assert x[0, 0] == SHAKESPEARE_VOCAB - 3  # bos
+        assert y[0, 2] == SHAKESPEARE_VOCAB - 2  # eos after 'a','b'
+        assert (x[0, 4:] == 0).all()  # padded
+
+    def test_long_snippet_splits(self):
+        x, _ = shakespeare_to_sequences(["z" * 200])
+        assert x.shape[0] == 3  # 202 tokens -> 3 windows of 81
+
+
+def _write_cifar10_batches(dirpath):
+    d = os.path.join(dirpath, "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for name, n in [("data_batch_1", 40), ("data_batch_2", 40), ("test_batch", 20)]:
+        blob = {
+            b"data": rng.randint(0, 256, (n, 3072), np.uint8),
+            b"labels": rng.randint(0, 10, n).tolist(),
+        }
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump(blob, f)
+
+
+class TestCifarBinary:
+    def test_loads_and_partitions(self, tmp_path, args_factory):
+        d = tmp_path / "cifar10"
+        _write_cifar10_batches(str(d))
+        args = _args(
+            args_factory,
+            dataset="cifar10",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=4,
+            client_num_per_round=4,
+            model="cnn",
+            partition_method="homo",
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.train_data_num == 80
+        assert ds.test_data_num == 20
+        assert ds.packed_train.x.shape[-3:] == (32, 32, 3)
+        assert float(ds.packed_train.x.max()) <= 1.0
+
+    def test_reader_shapes(self, tmp_path):
+        _write_cifar10_batches(str(tmp_path))
+        x_tr, y_tr, x_te, y_te = load_cifar_batches(str(tmp_path), "cifar10")
+        assert x_tr.shape == (80, 32, 32, 3)
+        assert y_te.shape == (20,)
+
+
+class TestRegroup:
+    def test_round_robin_fold(self):
+        xs = [np.full((i + 1, 2), i, np.float32) for i in range(5)]
+        ys = [np.full((i + 1,), i, np.int64) for i in range(5)]
+        fx, fy = regroup_clients(xs, ys, 2)
+        assert len(fx) == 2
+        assert sum(len(a) for a in fx) == 15
+        # user 0 and 2 and 4 land on client 0
+        assert set(np.unique(fy[0])) == {0, 2, 4}
