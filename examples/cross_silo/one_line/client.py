@@ -1,0 +1,9 @@
+"""Cross-silo FL client (reference: ...one_line/torch_client.py).
+
+Run:  python client.py --cf fedml_config.yaml --rank <1..N>
+"""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_cross_silo_client()

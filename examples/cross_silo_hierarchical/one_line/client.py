@@ -1,0 +1,17 @@
+"""Hierarchical cross-silo client: this FL client trains data-parallel
+over its local devices — in-silo DP is a mesh axis, not a process group
+(reference nests torch DDP here, trainer_dist_adapter.py:40-141).
+
+Run:  python client.py --cf fedml_config.yaml --rank <1..N>
+
+Multi-host silos (one OS process per host): set n_proc_in_silo,
+proc_rank_in_silo, distributed_coordinator, silo_backend: GRPC in the
+YAML — or spawn with
+fedml_tpu.cross_silo.hierarchical.launch_silo_processes (see
+tests/hier_mp_worker.py for the full recipe).
+"""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_hierarchical_cross_silo_client()

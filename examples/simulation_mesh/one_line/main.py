@@ -1,0 +1,18 @@
+"""One-line mesh simulation — the cohort's client axis sharded over all
+local devices, aggregation as an ICI all-reduce (the reference's
+SimulatorNCCL stub done for real; SURVEY.md §7 step 4).
+
+On a TPU slice this uses every chip. To try multi-chip semantics on a
+laptop:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python main.py --cf fedml_config.yaml
+
+client_num_per_round must tile the mesh's 'clients' axis (here 8).
+"""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    final_stats = fedml_tpu.run_simulation(backend="MESH")
+    print("FINAL:", final_stats)

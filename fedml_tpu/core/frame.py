@@ -193,6 +193,33 @@ class ServerAggregator(abc.ABC):
         return False
 
 
+def bind_operator(operator, model, args):
+    """Late-bind model/args onto a user-constructed operator. Users may
+    build a trainer before the model exists (the one-line API creates
+    the model internally, reference __init__.py:139-169) — engines call
+    this before ``make_train_fn`` so ``self.model``/``self.args`` are
+    always populated. User-supplied values are never overwritten, but a
+    value WE bound is re-bound on reuse (one trainer instance across
+    two engine constructions must track the second engine's model, not
+    go stale on the first), invalidating any jitted caches."""
+    if operator is None:
+        return None
+    if getattr(operator, "model", None) is None or getattr(
+        operator, "_auto_bound_model", False
+    ):
+        if operator.model is not model:
+            operator.model = model
+            operator._jitted_train = None
+            operator._jitted_eval = None
+        operator._auto_bound_model = True
+    if getattr(operator, "args", None) is None or getattr(
+        operator, "_auto_bound_args", False
+    ):
+        operator.args = args
+        operator._auto_bound_args = True
+    return operator
+
+
 class DefaultServerAggregator(ServerAggregator):
     """The stock operator: sample-weighted FedAvg mean
     (``core.aggregation.weighted_average``)."""

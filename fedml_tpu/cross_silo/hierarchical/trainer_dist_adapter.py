@@ -24,6 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...core.frame import bind_operator
 from ...core.local_trainer import make_local_train_fn
 from ...core.optimizers import create_client_optimizer
 from ...core.types import Batches
@@ -90,7 +91,7 @@ class TrainerDistAdapter:
             # L3 operator seam (core/frame.py): the custom pure train fn
             # is simply jitted with the silo's DP shardings — in-silo
             # data parallelism composes with custom operators for free.
-            local_fn = client_trainer.make_train_fn(args)
+            local_fn = bind_operator(client_trainer, model, args).make_train_fn(args)
         else:
             local_fn = make_local_train_fn(
                 model.apply,

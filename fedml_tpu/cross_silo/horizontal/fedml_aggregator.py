@@ -22,6 +22,7 @@ from ...core.aggregation import (
     stack_pytrees,
     weighted_average,
 )
+from ...core.frame import bind_operator
 from ...core.local_trainer import make_eval_fn
 
 Params = Any
@@ -32,7 +33,7 @@ class FedMLAggregator:
         self.args = args
         self.model = model
         self.test_data = test_data
-        self.server_aggregator = server_aggregator
+        self.server_aggregator = bind_operator(server_aggregator, model, args)
         self._agg_round = 0
         self.client_num = int(args.client_num_per_round)
         self.model_dict: Dict[int, Params] = {}

@@ -15,6 +15,7 @@ from typing import Optional
 import jax
 
 from ... import constants
+from ...core.frame import bind_operator
 from ...core.local_trainer import make_local_train_fn
 from ...core.managers import ClientManager
 from ...core.message import Message
@@ -34,7 +35,7 @@ class FedMLTrainer:
         if client_trainer is not None:
             # L3 operator seam (core/frame.py): same custom pure train
             # fn the simulators consume, here jitted per-silo.
-            fn = client_trainer.make_train_fn(args)
+            fn = bind_operator(client_trainer, model, args).make_train_fn(args)
         else:
             fn = make_local_train_fn(
                 model.apply,

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.frame import bind_operator
 from ..core.aggregation import (
     RobustAggregator,
     normalize_weights,
@@ -105,8 +106,8 @@ class FedAvgAPI:
                 f"{self.algorithm} defines its own server aggregation; a "
                 "custom server_aggregator would be ignored — not supported"
             )
-        self.client_trainer = client_trainer
-        self.server_aggregator = server_aggregator
+        self.client_trainer = bind_operator(client_trainer, model, args)
+        self.server_aggregator = bind_operator(server_aggregator, model, args)
         self.mode = getattr(args, "sim_mode", "vectorized")
         if self.mode == "sequential" and (
             self._keep_stacked

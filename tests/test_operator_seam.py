@@ -254,6 +254,31 @@ class TestCrossSiloSeam:
         )
 
 
+class TestOperatorBinding:
+    def test_reused_operator_rebinds_to_new_model(self, args_factory):
+        """One trainer instance across two engine constructions must
+        track the second engine's model, not go stale on the first."""
+        from fedml_tpu.core.frame import bind_operator
+
+        trainer = HalfStepTrainer(model=None)
+        args = _mk(args_factory)
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model_lr = models.create(args, ds.class_num)
+        bind_operator(trainer, model_lr, args)
+        assert trainer.model is model_lr
+        args2 = _mk(args_factory, model="cnn", dataset="femnist")
+        args2 = fedml_tpu.init(args2)
+        ds2 = load(args2)
+        model_cnn = models.create(args2, ds2.class_num)
+        bind_operator(trainer, model_cnn, args2)
+        assert trainer.model is model_cnn  # auto-bound -> rebinds
+        # but a user-set model is never overwritten
+        t2 = HalfStepTrainer(model_lr)
+        bind_operator(t2, model_cnn, args2)
+        assert t2.model is model_lr
+
+
 class TestImperativeSurface:
     """Reference-parity surface: get/set params + train(data) works."""
 
