@@ -62,6 +62,10 @@ _DEFAULTS: Dict[str, Any] = {
     "server_momentum": 0.0,
     # fedprox / fednova
     "fedprox_mu": 0.0,
+    # straggler handling (cross-silo; beyond the reference): aggregate
+    # whoever reported within this many seconds of the round broadcast,
+    # reweighted over the subset. 0 = wait for everyone (reference).
+    "aggregation_deadline_s": 0.0,
     # validation
     "frequency_of_the_test": 5,
     # device

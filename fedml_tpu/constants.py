@@ -71,3 +71,7 @@ CLIENT_STATUS_IDLE = "IDLE"
 # the same triple travels as a message on a silo-private fabric).
 MSG_TYPE_SILO_SYNC_PROCESS_GROUP = 20
 MSG_TYPE_SILO_FINISH = 21
+
+# server-internal: aggregation deadline fired (straggler handling —
+# beyond the reference, which always waits for every client)
+MSG_TYPE_S2S_AGG_DEADLINE = 30

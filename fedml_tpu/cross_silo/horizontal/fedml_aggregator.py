@@ -84,11 +84,19 @@ class FedMLAggregator:
             self.flag_client_model_uploaded_dict[idx] = False
         return True
 
+    def num_received(self) -> int:
+        return len(self.model_dict)
+
     def aggregate(self) -> Params:
         """Weighted average of the received models
-        (fedml_aggregator.py:73-101)."""
-        trees = [self.model_dict[i] for i in range(self.client_num)]
-        ns = jnp.asarray([self.sample_num_dict[i] for i in range(self.client_num)])
+        (fedml_aggregator.py:73-101). Aggregates whatever has been
+        received — under a deadline cohort (straggler handling) that
+        may be a subset; weights renormalize over the subset."""
+        idxs = sorted(self.model_dict.keys())
+        if not idxs:
+            raise RuntimeError("aggregate() with no received models")
+        trees = [self.model_dict[i] for i in idxs]
+        ns = jnp.asarray([self.sample_num_dict[i] for i in idxs])
         stacked = stack_pytrees(trees)
         weights = normalize_weights(ns)
         if self.server_aggregator is not None:
@@ -105,6 +113,7 @@ class FedMLAggregator:
         self._agg_round += 1
         self.model_dict.clear()
         self.sample_num_dict.clear()
+        self.flag_client_model_uploaded_dict.clear()
         return self.global_params
 
     # -- selection (fedml_aggregator.py:103-153) ----------------------

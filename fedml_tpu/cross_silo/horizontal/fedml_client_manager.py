@@ -130,5 +130,7 @@ class FedMLClientManager(ClientManager):
         )
         out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         out.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, n)
+        # round tag: lets a deadline-cohort server discard stale uploads
+        out.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         with self.profiler.span("comm_c2s"):
             self.send_message(out)

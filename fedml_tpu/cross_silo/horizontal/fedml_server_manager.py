@@ -1,4 +1,5 @@
-"""Cross-silo server manager: presence handshake + round loop.
+"""Cross-silo server manager: presence handshake + round loop +
+deadline cohort (straggler handling).
 
 Parity with ``python/fedml/cross_silo/horizontal/fedml_server_manager.py:11-235``:
 
@@ -13,6 +14,15 @@ Parity with ``python/fedml/cross_silo/horizontal/fedml_server_manager.py:11-235`
 The terminal round sends ``MSG_TYPE_S2C_FINISH`` so clients exit their
 receive loops cleanly (the reference relies on ``finish()`` +
 sys.exit, fedml_server_manager.py:209-213).
+
+**Beyond the reference — deadline cohort**: the reference's server
+waits for EVERY selected client, so one straggler stalls the whole
+federation. With ``args.aggregation_deadline_s`` set, the server arms a
+timer per round; when it fires it aggregates whoever reported by then
+(weights renormalize over the subset) and moves on. Late uploads carry
+their round tag and are discarded with a log line. The timer thread
+never touches state directly — it posts a message to the server's own
+inbox, so all mutation stays on the single dispatch thread.
 """
 
 from __future__ import annotations
@@ -76,6 +86,9 @@ class FedMLServerManager(ServerManager):
         self.profiler = ProfilerEvent(args)
         self.metrics_reporter = MetricsReporter(args, keep_history=False)
         self._wait_open = False
+        self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
+        self._deadline_timer = None
+        self.stragglers_dropped = 0
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -86,6 +99,10 @@ class FedMLServerManager(ServerManager):
         self.register_message_receive_handler(
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2S_AGG_DEADLINE,
+            self.handle_message_deadline,
         )
 
     def handle_message_client_status_update(self, msg: Message) -> None:
@@ -126,10 +143,82 @@ class FedMLServerManager(ServerManager):
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
             self.send_message(msg)
+        self._arm_deadline()
+
+    # -- deadline cohort (beyond the reference) -----------------------
+    def _arm_deadline(self) -> None:
+        if self.deadline_s <= 0:
+            return
+        import threading
+
+        round_idx = self.round_idx
+
+        def fire() -> None:
+            # post to our own inbox; never mutate from the timer thread.
+            # A lost deadline message re-creates the straggler hang this
+            # feature exists to prevent, so transient send failures are
+            # retried and logged loudly.
+            import time as _time
+
+            msg = Message(constants.MSG_TYPE_S2S_AGG_DEADLINE, self.rank, self.rank)
+            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+            for attempt in range(3):
+                try:
+                    self.send_message(msg)
+                    return
+                except Exception:  # noqa: BLE001 — transport may be down
+                    if round_idx != self.round_idx:
+                        return  # round advanced/finished; stale fire
+                    logging.warning(
+                        "deadline message send failed (attempt %d/3)",
+                        attempt + 1, exc_info=True,
+                    )
+                    _time.sleep(1.0)
+            logging.error(
+                "deadline for round %d could not be delivered; the round "
+                "will only advance when all clients report", round_idx,
+            )
+
+        self._deadline_timer = threading.Timer(self.deadline_s, fire)
+        self._deadline_timer.daemon = True
+        self._deadline_timer.start()
+
+    def _cancel_deadline(self) -> None:
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
+
+    def handle_message_deadline(self, msg: Message) -> None:
+        fired_round = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, -1))
+        if fired_round != self.round_idx:
+            return  # the round completed in time; stale timer
+        n = self.aggregator.num_received()
+        if n == 0:
+            logging.warning(
+                "round %d deadline (%.1fs) with ZERO uploads; extending",
+                self.round_idx, self.deadline_s,
+            )
+            self._arm_deadline()
+            return
+        expected = self.aggregator.client_num  # per-round cohort size
+        missing = max(expected - n, 0)
+        self.stragglers_dropped += missing
+        logging.warning(
+            "round %d deadline: aggregating %d/%d clients (%d straggler(s) dropped)",
+            self.round_idx, n, expected, missing,
+        )
+        self._finish_round()
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         """(fedml_server_manager.py:121-207)"""
         sender_rank = int(msg.get_sender_id())
+        upload_round = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx))
+        if upload_round != self.round_idx:
+            logging.warning(
+                "discarding straggler upload from rank %d for round %d "
+                "(now on round %d)", sender_rank, upload_round, self.round_idx,
+            )
+            return
         model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
@@ -140,13 +229,24 @@ class FedMLServerManager(ServerManager):
             self._wait_open = True
         if not self.aggregator.check_whether_all_receive():
             return
-        self.profiler.log_event_ended("server.wait")
-        self._wait_open = False
+        self._finish_round()
+
+    def _finish_round(self) -> None:
+        """Aggregate whatever was received, eval, advance (shared by
+        the all-received and deadline paths)."""
+        self._cancel_deadline()
+        if self._wait_open:
+            self.profiler.log_event_ended("server.wait")
+            self._wait_open = False
         with self.profiler.span("aggregate"):
             self.aggregator.aggregate()
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
         self.metrics_reporter.report(
-            {"kind": "round_info", "round": self.round_idx, "clients": len(self.client_real_ids)}
+            {
+                "kind": "round_info",
+                "round": self.round_idx,
+                "clients": self.aggregator.client_num,
+            }
         )
         self.round_idx += 1
         if self.round_idx >= self.round_num:
