@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Full suite: every scenario single-host on the 8-device virtual CPU
+# mesh (SURVEY.md §4 "multi-node without a cluster"), including the
+# 2-OS-process multi-controller hierarchical test and all examples.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

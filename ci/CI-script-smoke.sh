@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Fast gate: the smoke tier (<60s warm) — unit core, oracles, native
+# runtime, transports, operator seam, data ingestion.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -m smoke -q "$@"

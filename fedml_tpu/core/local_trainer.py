@@ -129,11 +129,17 @@ def make_eval_fn(
             x, y, m = batch
             logits = apply_fn(params, x)
             loss, metrics = loss_fn(logits, y, m)
-            return None, {
+            out = {
                 "loss_sum": (loss * metrics["count"]),
                 "correct": metrics["correct"],
                 "count": metrics["count"],
             }
+            # task-specific extras ride along (tag prediction's tp/fp/fn
+            # feed precision/recall/F1 in metrics_from_sums)
+            for k in ("tp", "fp", "fn"):
+                if k in metrics:
+                    out[k] = metrics[k]
+            return None, out
 
         _, out = jax.lax.scan(step, None, (batches.x, batches.y, batches.mask))
         return jax.tree.map(lambda x: x.sum(), out)

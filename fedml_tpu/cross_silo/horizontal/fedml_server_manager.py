@@ -238,6 +238,7 @@ class FedMLServerManager(ServerManager):
         if self._wait_open:
             self.profiler.log_event_ended("server.wait")
             self._wait_open = False
+        n_aggregated = self.aggregator.num_received()
         with self.profiler.span("aggregate"):
             self.aggregator.aggregate()
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
@@ -246,6 +247,7 @@ class FedMLServerManager(ServerManager):
                 "kind": "round_info",
                 "round": self.round_idx,
                 "clients": self.aggregator.client_num,
+                "clients_aggregated": n_aggregated,
             }
         )
         self.round_idx += 1

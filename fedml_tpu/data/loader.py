@@ -45,6 +45,7 @@ from .packing import bucket_num_batches, pack_clients, pack_one
 from .synthetic import (
     synthetic_classification,
     synthetic_fedprox,
+    synthetic_multilabel,
     synthetic_segmentation,
     synthetic_sequences,
 )
@@ -61,6 +62,10 @@ _DATASET_META = {
     "shakespeare": ((80,), 90, 16000, 2000, "nwp"),
     "fed_shakespeare": ((80,), 90, 16000, 2000, "nwp"),
     "stackoverflow_nwp": ((20,), 10004, 40000, 8000, "nwp"),
+    # multi-label tag prediction (reference data/stackoverflow_lr/:
+    # 10k bag-of-words -> 500 tags); the synthetic stand-in shrinks the
+    # feature dim so the offline path stays in memory
+    "stackoverflow_lr": ((10000,), 500, 40000, 8000, "tag_prediction"),
     # federated segmentation (fedseg benchmarks; stand-in shapes keep
     # H/W modest — a real copy under data_cache_dir overrides)
     "pascal_voc": ((64, 64, 3), 21, 4000, 800, "segmentation"),
@@ -173,6 +178,10 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
         seq_len, vocab = shape[0], class_num
         x_tr, y_tr = synthetic_sequences(train_n, seq_len, vocab, seed)
         x_te, y_te = synthetic_sequences(test_n, seq_len, vocab, seed + 1)
+    elif task == "tag_prediction":
+        dim = int(getattr(args, "synthetic_feature_dim", 2000))
+        x_tr, y_tr = synthetic_multilabel(train_n, class_num, (dim,), seed)
+        x_te, y_te = synthetic_multilabel(test_n, class_num, (dim,), seed + 1)
     elif task == "segmentation":
         x_tr, y_tr = synthetic_segmentation(train_n, class_num, shape, seed)
         x_te, y_te = synthetic_segmentation(test_n, class_num, shape, seed + 1)
@@ -217,6 +226,10 @@ def load(args) -> FederatedDataset:
 
         _, class_num, _, _, task = _DATASET_META[name]
         xs_tr, ys_tr, xs_te, ys_te = fed
+        if task == "tag_prediction" and xs_tr:
+            # model factory sizes the input layer off args (real copies
+            # may differ from the synthetic stand-in's bow dim)
+            args.input_dim = int(xs_tr[0].shape[-1])
         n_users = len(xs_tr)
         if client_num > n_users:
             logging.warning(
@@ -230,10 +243,23 @@ def load(args) -> FederatedDataset:
         xs_te, ys_te = regroup_clients(xs_te, ys_te, client_num)
     else:
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
+        if task == "tag_prediction":
+            # model factory sizes the input layer off args (the bow dim
+            # differs between real data and the synthetic stand-in)
+            args.input_dim = int(x_tr.shape[-1])
         method = getattr(args, "partition_method", constants.PARTITION_HETERO)
         if method == constants.PARTITION_HOMO:
             idx_map = homo_partition(len(y_tr), client_num, seed)
             part_labels = None
+        elif task == "tag_prediction":
+            # multi-hot labels: LDA partitions on each sample's
+            # dominant tag (the reference's stackoverflow split is
+            # naturally federated; this applies to synthetic/npz data)
+            part_labels = np.argmax(y_tr, axis=-1)
+            idx_map = non_iid_partition_with_dirichlet_distribution(
+                part_labels, client_num, class_num,
+                float(getattr(args, "partition_alpha", 0.5)), seed=seed,
+            )
         elif task == "segmentation":
             # multi-label LDA (the partitioner's fedseg branch): per
             # foreground class, the index array of images containing it;

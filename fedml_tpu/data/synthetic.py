@@ -128,3 +128,31 @@ def synthetic_sequences(
         u = rng.rand(n_samples, 1)
         toks[:, t + 1] = (u > cum).sum(axis=1)
     return toks[:, :-1], toks[:, 1:]
+
+
+def synthetic_multilabel(
+    n_samples: int,
+    num_tags: int,
+    feature_shape: Tuple[int, ...],
+    seed: int = 0,
+    tags_per_sample: int = 3,
+    sigma: float = 0.5,
+    means_seed: int = 1234,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-hot tag-prediction stand-in (stackoverflow_lr shape): each
+    sample carries 1..tags_per_sample tags; features are the sum of the
+    active tags' embedding vectors + noise, so a linear sigmoid model
+    is learnable. Returns (x [N, *shape], y multi-hot [N, num_tags])."""
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(feature_shape))
+    emb = np.random.RandomState(means_seed).normal(
+        0, 1, (num_tags, dim)
+    ).astype(np.float32)
+    y = np.zeros((n_samples, num_tags), np.float32)
+    x = sigma * rng.normal(0, 1, (n_samples, dim)).astype(np.float32)
+    counts = rng.randint(1, tags_per_sample + 1, n_samples)
+    for i in range(n_samples):
+        tags = rng.choice(num_tags, counts[i], replace=False)
+        y[i, tags] = 1.0
+        x[i] += emb[tags].sum(axis=0)
+    return x.reshape((n_samples,) + feature_shape), y

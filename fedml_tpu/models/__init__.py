@@ -32,8 +32,10 @@ _IMAGE_SHAPES = {
 
 
 def _example_shape(args, default=(28, 28, 1)):
-    ds = getattr(args, "dataset", "synthetic")
-    if ds == "synthetic":
+    ds = getattr(args, "dataset", "synthetic").lower()
+    if ds == "synthetic" or ds == "stackoverflow_lr":
+        # flat-feature datasets: the loader records the realized dim
+        # (synthetic fedprox input_dim; stackoverflow bag-of-words)
         dim = int(getattr(args, "input_dim", 60))
         return (dim,)
     return _IMAGE_SHAPES.get(ds, default)
@@ -44,11 +46,14 @@ def create(args, output_dim: int) -> FedModel:
     name = getattr(args, "model", "lr").lower()
     ds = getattr(args, "dataset", "synthetic").lower()
 
+    # multi-label tag prediction (model_hub pairs lr/stackoverflow_lr):
+    # same linear/MLP modules, sigmoid-BCE task
+    task = "tag_prediction" if ds == "stackoverflow_lr" else "classification"
     if name == "lr":
         return FedModel(
             name="lr",
             module=LogisticRegression(output_dim),
-            task="classification",
+            task=task,
             example_shape=_example_shape(args),
         )
     if name == "mlp":
@@ -56,7 +61,7 @@ def create(args, output_dim: int) -> FedModel:
         return FedModel(
             name="mlp",
             module=MLP(hidden, output_dim),
-            task="classification",
+            task=task,
             example_shape=_example_shape(args),
         )
     if name == "cnn":
