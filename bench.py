@@ -62,6 +62,14 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _progress(msg: str) -> None:
+    """Phase breadcrumbs on STDERR (stdout carries only the JSON line)."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def _probe_tpu() -> tuple[bool, str]:
     """Initialize the TPU backend in a subprocess (bounded time)."""
     code = (
@@ -90,7 +98,9 @@ def _probe_tpu() -> tuple[bool, str]:
             last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["rc=%d" % r.returncode]
             last = last[0]
         except subprocess.TimeoutExpired:
-            last = f"probe timeout after {PROBE_TIMEOUT_S}s"
+            # a stalled tunnel stays stalled — retrying only burns the
+            # CPU fallback's budget. Retry is for quick crashes only.
+            return False, f"probe timeout after {PROBE_TIMEOUT_S}s"
     return False, last
 
 
@@ -255,21 +265,30 @@ def _aggregation_exchange(model, n_iter: int = 20) -> dict:
 def run_bench(on_cpu: bool) -> dict:
     import jax
 
+    _progress(f"backend up: {jax.devices()[0]}")
+
     # headline config matches BENCH_r02 for cross-round comparability
     n_clients = 8 if on_cpu else 32
     epochs = 1 if on_cpu else 5
     n_rounds = 3 if on_cpu else 10
     n_seq = 1 if on_cpu else 2
     # the scaling sweep is a TPU metric; the CPU emergency fallback
-    # keeps only the headline so it stays inside the driver budget.
-    # Three cohort sizes keep the whole bench comfortably under the
-    # driver's ~580s window even on a loaded host.
+    # keeps only the headline (on 6x less data per client) so even a
+    # worst-case stalled-probe start (~120s) finishes inside the
+    # driver's ~580s window (measured ~290s end to end). Three sweep
+    # cohorts keep the TPU path under it too.
     sweep_cohorts = [] if on_cpu else [8, 32, 256]
     per_client = 100
+    headline_per_client = 100 if on_cpu else 600
 
-    args, dataset, model, api = _build_api(n_clients, epochs)
+    args, dataset, model, api = _build_api(
+        n_clients, epochs, per_client=headline_per_client
+    )
+    _progress("headline built")
     vec_rps, samples_per_round, flops = _time_rounds(api, dataset, args, n_rounds)
+    _progress(f"headline timed: {vec_rps:.3f} rounds/s")
     seq_rps = _sequential_baseline(api, dataset, args, n_seq)
+    _progress(f"sequential baseline: {seq_rps:.4f} rounds/s")
 
     # the headline round is a plain jit on ONE device — per-chip and
     # MFU figures are for that chip; mesh-sharded multi-chip runs are
@@ -294,11 +313,13 @@ def run_bench(on_cpu: bool) -> dict:
         achieved = flops * vec_rps
         detail["model_flops_per_sec"] = round(achieved, 1)
         detail["flops_source"] = "xla_cost_analysis (static estimate)"
-        kind = getattr(jax.devices()[0], "device_kind", "")
-        peak = next(
-            (v * 1e12 for k, v in _PEAK_TFLOPS.items() if k.lower() in kind.lower()),
-            None,
-        )
+        kind = getattr(jax.devices()[0], "device_kind", "").lower()
+        # longest-match so e.g. a hypothetical "TPU v4i" never matches
+        # the "TPU v4" entry's peak
+        matches = [
+            (len(k), v) for k, v in _PEAK_TFLOPS.items() if k.lower() in kind
+        ]
+        peak = max(matches)[1] * 1e12 if matches else None
         if peak:
             detail["mfu_vs_bf16_peak"] = round(achieved / (peak * n_chips), 4)
             detail["peak_assumed_tflops"] = peak / 1e12
@@ -310,6 +331,7 @@ def run_bench(on_cpu: bool) -> dict:
     for c in sweep_cohorts:
         a_c, ds_c, _m_c, api_c = _build_api(c, epochs=1, per_client=per_client)
         rps_c, spr_c, _ = _time_rounds(api_c, ds_c, a_c, n_rounds=3)
+        _progress(f"sweep cohort {c}: {rps_c:.3f} rounds/s")
         sps_c = rps_c * spr_c
         if base_sps is None:
             base_sps, base_clients = sps_c, c
@@ -339,7 +361,9 @@ def run_bench(on_cpu: bool) -> dict:
 
 
 def main() -> None:
+    _progress("probing TPU")
     tpu_ok, note = _probe_tpu()
+    _progress(f"probe: ok={tpu_ok} ({note})")
     if tpu_ok:
         os.environ.pop("JAX_PLATFORMS", None)
     else:

@@ -78,23 +78,48 @@ def pad_federation(
     )
 
 
+def is_multi_controller(mesh: Mesh) -> bool:
+    """True when the mesh spans devices of more than one host process
+    (jax.distributed multi-controller run)."""
+    return any(d.process_index != jax.process_index() for d in mesh.devices.flat)
+
+
+def _put(a: Any, sharding: NamedSharding, multi: bool):
+    """Host array -> (global) device array. Single controller:
+    device_put. Multi-controller: every process holds the same full
+    host copy (same seed -> same data) and ``make_array_from_callback``
+    hands each process exactly the shards it owns."""
+    if not multi:
+        return jax.device_put(a, sharding)
+    host = np.asarray(a)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx, _h=host: _h[idx]
+    )
+
+
 def shard_federation(
     packed: Batches, num_samples, mesh: Mesh
 ) -> Tuple[Batches, jax.Array]:
-    """Place the packed federation on the mesh (client axis sharded)."""
+    """Place the packed federation on the mesh (client axis sharded).
+    Works on a single host and across a multi-controller process group
+    (each process materializes only its addressable shards)."""
     spec = federation_spec(mesh)
     sharding = NamedSharding(mesh, spec)
-    f = lambda a: jax.device_put(a, sharding)
+    multi = is_multi_controller(mesh)
+    f = lambda a: _put(a, sharding, multi)
     import jax.numpy as jnp
 
-    ns = jax.device_put(jnp.asarray(num_samples), NamedSharding(mesh, P("clients")))
+    ns = _put(
+        jnp.asarray(num_samples), NamedSharding(mesh, P("clients")), multi
+    )
     return Batches(x=f(packed.x), y=f(packed.y), mask=f(packed.mask)), ns
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Replicate a pytree (global params / opt state) across the mesh."""
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    multi = is_multi_controller(mesh)
+    return jax.tree.map(lambda a: _put(a, sharding, multi), tree)
 
 
 def pad_cohort_to_mesh(cohort_size: int, mesh: Mesh) -> int:

@@ -101,6 +101,12 @@ class FedAvgAPI:
         self.dataset = dataset
         self.model = model
         self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import is_multi_controller
+
+            self._multi_controller = is_multi_controller(mesh)
+        else:
+            self._multi_controller = False
         if server_aggregator is not None and not self._accepts_custom_aggregator:
             raise ValueError(
                 f"{self.algorithm} defines its own server aggregation; a "
@@ -251,9 +257,14 @@ class FedAvgAPI:
     # -- round loop ----------------------------------------------------
     def train(self) -> Dict[str, float]:
         args = self.args
-        packed, nsamples = (
-            self.dataset.packed_train,
-            jnp.asarray(self.dataset.packed_num_samples),
+        # jit inputs under multi-controller must be global arrays or
+        # process-consistent host values — never locally-committed
+        # device arrays (every process holds the same host copy)
+        packed = self.dataset.packed_train
+        nsamples = (
+            np.asarray(self.dataset.packed_num_samples)
+            if self._multi_controller
+            else jnp.asarray(self.dataset.packed_num_samples)
         )
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
@@ -277,6 +288,8 @@ class FedAvgAPI:
                 round_idx, self.dataset.client_num, int(args.client_num_per_round)
             )
             self.rng, round_rng = jax.random.split(self.rng)
+            if self._multi_controller:
+                round_rng = np.asarray(round_rng)  # process-consistent host value
             with self.profiler.span("round"):
                 if self.mode == "sequential":
                     new_global, summed = self._sequential_round(idx, round_rng)
@@ -287,7 +300,7 @@ class FedAvgAPI:
                         self.server_state,
                         packed,
                         nsamples,
-                        jnp.asarray(idx),
+                        np.asarray(idx) if self._multi_controller else jnp.asarray(idx),
                         round_rng,
                     )
                     self.global_params, self.server_state, summed = out[:3]

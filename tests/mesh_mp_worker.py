@@ -1,0 +1,76 @@
+"""One host process of a multi-controller MESH simulation (test worker).
+
+The v4-64 north-star seam: the client-parallel simulator's global mesh
+spans several host processes (``jax.distributed``); every process runs
+the SAME jitted FedAvg round, XLA runs it as one SPMD computation with
+the weighted reduction as a cross-process all-reduce. Spawned by
+``tests/test_multiprocess_mesh.py``.
+"""
+
+import argparse
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--proc_rank", type=int, required=True)
+    p.add_argument("--n_proc", type=int, required=True)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--out", default="")
+    ns = p.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=ns.coordinator,
+        num_processes=ns.n_proc,
+        process_id=ns.proc_rank,
+    )
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == ns.n_proc
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data import load
+    from fedml_tpu.simulation.simulator import SimulatorMesh
+
+    args = Arguments()
+    for k, v in dict(
+        training_type="simulation",
+        backend="MESH",
+        dataset="mnist",
+        synthetic_train_size=512,
+        synthetic_test_size=128,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=8,
+        client_num_per_round=8,
+        comm_round=2,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        shuffle=False,
+        mesh_shape={"clients": 8},
+    ).items():
+        setattr(args, k, v)
+    args._validate()
+    args = fedml_tpu.init(args)
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    sim = SimulatorMesh(args, None, dataset, model)
+    sim.run()
+
+    if ns.proc_rank == 0 and ns.out:
+        params = sim.fl_trainer.global_params
+        flat = {f"p{i}": np.asarray(x) for i, x in enumerate(jax.tree.leaves(params))}
+        np.savez(ns.out, **flat)
+    print("MESH_WORKER_DONE", ns.proc_rank, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
