@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -234,3 +234,150 @@ def regroup_clients(
         [np.concatenate(b) for b in out_x],
         [np.concatenate(b) for b in out_y],
     )
+
+
+# -- image-folder (ImageNet-style) and Landmarks CSV ------------------
+
+
+def _decode_image(path: str, hw: Tuple[int, int]) -> np.ndarray:
+    """Decode + resize one image to [H, W, 3] float32 in [0,1]."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((hw[1], hw[0]))
+        return np.asarray(im, dtype=np.float32) / 255.0
+
+
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+def image_folder_available(data_dir: str) -> bool:
+    """ImageNet-style layout: <dir>/train/<class_name>/<img>."""
+    train = os.path.join(data_dir, "train")
+    if not os.path.isdir(train):
+        return False
+    for cls in os.listdir(train):
+        d = os.path.join(train, cls)
+        if os.path.isdir(d) and any(
+            f.lower().endswith(_IMAGE_EXTS) for f in os.listdir(d)
+        ):
+            return True
+    return False
+
+
+def load_image_folder(
+    data_dir: str, image_hw: Tuple[int, int] = (64, 64)
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """ImageNet-style class-per-directory ingestion (the reference's
+    truncated-ImageNet datasets, ``data/ImageNet/``): <dir>/{train,val
+    or test}/<class_name>/*.jpg -> global arrays + class count. Class
+    ids follow sorted class-name order (torchvision convention)."""
+    train_dir = os.path.join(data_dir, "train")
+    test_dir = next(
+        (
+            os.path.join(data_dir, s)
+            for s in ("val", "test")
+            if os.path.isdir(os.path.join(data_dir, s))
+        ),
+        None,
+    )
+    classes = sorted(
+        c for c in os.listdir(train_dir)
+        if os.path.isdir(os.path.join(train_dir, c))
+    )
+    cls_id = {c: i for i, c in enumerate(classes)}
+
+    def read_split(split_dir):
+        xs, ys = [], []
+        for c in classes:
+            d = os.path.join(split_dir, c)
+            if not os.path.isdir(d):
+                continue
+            for f in sorted(os.listdir(d)):
+                if f.lower().endswith(_IMAGE_EXTS):
+                    xs.append(_decode_image(os.path.join(d, f), image_hw))
+                    ys.append(cls_id[c])
+        if not xs:
+            return (
+                np.zeros((0,) + image_hw + (3,), np.float32),
+                np.zeros((0,), np.int64),
+            )
+        return np.stack(xs), np.asarray(ys, np.int64)
+
+    x_tr, y_tr = read_split(train_dir)
+    x_te, y_te = read_split(test_dir) if test_dir else (
+        np.zeros((0,) + image_hw + (3,), np.float32), np.zeros((0,), np.int64)
+    )
+    logging.info(
+        "image folder %s: %d classes, %d train / %d test",
+        data_dir, len(classes), len(y_tr), len(y_te),
+    )
+    return x_tr, y_tr, x_te, y_te, len(classes)
+
+
+def landmarks_csv_available(data_dir: str) -> bool:
+    return os.path.isfile(os.path.join(data_dir, "train.csv")) and os.path.isdir(
+        os.path.join(data_dir, "images")
+    )
+
+
+def load_landmarks_csv(
+    data_dir: str, image_hw: Tuple[int, int] = (64, 64)
+) -> Tuple[List[np.ndarray], List[np.ndarray], List[np.ndarray], List[np.ndarray]]:
+    """Landmarks-style naturally-federated CSV mapping (reference
+    ``data/Landmarks/data_loader.py:120-160``): ``train.csv`` rows
+    ``user_id,image_id,class`` with images at ``images/<image_id>.jpg``
+    (any supported extension). An optional ``test.csv`` (no user
+    grouping required) supplies held-out data, sharded uniformly across
+    users like the reference's test loaders."""
+    import csv
+
+    def read_rows(path):
+        with open(path) as f:
+            return list(csv.DictReader(f))
+
+    img_dir = os.path.join(data_dir, "images")
+
+    def img(image_id):
+        for ext in _IMAGE_EXTS:
+            p = os.path.join(img_dir, image_id + ext)
+            if os.path.isfile(p):
+                return _decode_image(p, image_hw)
+        raise FileNotFoundError(f"image {image_id} not under {img_dir}")
+
+    rows = read_rows(os.path.join(data_dir, "train.csv"))
+    if not rows:
+        raise ValueError(f"{data_dir}/train.csv has no data rows")
+    per_user: Dict[str, List] = {}
+    for r in rows:
+        per_user.setdefault(r["user_id"], []).append(r)
+    # numeric ids in numeric order, then non-numeric lexicographically
+    # (mixed id kinds must not break the sort)
+    users = sorted(
+        per_user, key=lambda u: (0, int(u), "") if u.isdigit() else (1, 0, u)
+    )
+    xs_tr = [np.stack([img(r["image_id"]) for r in per_user[u]]) for u in users]
+    ys_tr = [
+        np.asarray([int(r["class"]) for r in per_user[u]], np.int64) for u in users
+    ]
+
+    test_path = os.path.join(data_dir, "test.csv")
+    n = len(users)
+    if os.path.isfile(test_path):
+        te_rows = read_rows(test_path)
+        x_te = [img(r["image_id"]) for r in te_rows]
+        y_te = [int(r["class"]) for r in te_rows]
+        xs_te = [
+            np.stack(x_te[i::n]) if x_te[i::n] else
+            np.zeros((0,) + xs_tr[0].shape[1:], np.float32)
+            for i in range(n)
+        ]
+        ys_te = [np.asarray(y_te[i::n], np.int64) for i in range(n)]
+    else:
+        xs_te = [np.zeros((0,) + xs_tr[0].shape[1:], np.float32)] * n
+        ys_te = [np.zeros((0,), np.int64)] * n
+    logging.info(
+        "landmarks csv %s: %d users, %d train samples",
+        data_dir, n, sum(len(y) for y in ys_tr),
+    )
+    return xs_tr, ys_tr, xs_te, ys_te

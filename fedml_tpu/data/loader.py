@@ -66,6 +66,13 @@ _DATASET_META = {
     # 10k bag-of-words -> 500 tags); the synthetic stand-in shrinks the
     # feature dim so the offline path stays in memory
     "stackoverflow_lr": ((10000,), 500, 40000, 8000, "tag_prediction"),
+    # image-folder / CSV-federated image benchmarks (ImageNet-style
+    # class dirs; Landmarks user->image csv). Stand-in shapes keep H/W
+    # modest — real copies under data_cache_dir override, resized to
+    # args.image_size (default 64).
+    "imagenet": ((64, 64, 3), 1000, 20000, 2000, "classification"),
+    "gld23k": ((64, 64, 3), 203, 23080, 1000, "classification"),
+    "gld160k": ((64, 64, 3), 2028, 164172, 1000, "classification"),
     # federated segmentation (fedseg benchmarks; stand-in shapes keep
     # H/W modest — a real copy under data_cache_dir overrides)
     "pascal_voc": ((64, 64, 3), 21, 4000, 800, "segmentation"),
@@ -105,14 +112,22 @@ class FederatedDataset:
         ]
 
 
-def _try_load_real(name: str, cache_dir: str):
-    """Global real data: CIFAR python batches, else {train,test}.npz."""
+def _try_load_real(name: str, cache_dir: str, args=None):
+    """Global real data: CIFAR python batches, ImageNet-style image
+    folders, else the generic {train,test}.npz drop-in."""
     d = os.path.join(cache_dir or "", name)
     if name in ("cifar10", "cifar100"):
         from .ingest import cifar_batches_available, load_cifar_batches
 
         if cifar_batches_available(d, name):
             return load_cifar_batches(d, name)
+    from .ingest import image_folder_available, load_image_folder
+
+    if image_folder_available(d):
+        hw = int(getattr(args, "image_size", 64) or 64) if args else 64
+        # 5-tuple: the folder structure is authoritative for class
+        # count (truncated ImageNet copies carry fewer classes)
+        return load_image_folder(d, (hw, hw))
     tr, te = os.path.join(d, "train.npz"), os.path.join(d, "test.npz")
     if os.path.exists(tr) and os.path.exists(te):
         a, b = np.load(tr), np.load(te)
@@ -120,7 +135,7 @@ def _try_load_real(name: str, cache_dir: str):
     return None
 
 
-def _try_load_federated(name: str, cache_dir: str):
+def _try_load_federated(name: str, cache_dir: str, args=None):
     """Naturally-federated on-disk sources: LEAF json dirs, TFF h5.
     Returns per-client (xs_tr, ys_tr, xs_te, ys_te) or None."""
     if name not in _DATASET_META:
@@ -144,6 +159,9 @@ def _try_load_federated(name: str, cache_dir: str):
             out = load_leaf(d, feature_shape=shape)
     if out is None and ingest.tff_h5_available(d, name):
         out = ingest.load_tff_h5(d, name)
+    if out is None and ingest.landmarks_csv_available(d):
+        hw = int(getattr(args, "image_size", 0) or shape[0])
+        out = ingest.load_landmarks_csv(d, (hw, hw))
     if out is None:
         return None
     xs_tr, ys_tr, xs_te, ys_te = out
@@ -163,9 +181,18 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
     if name not in _DATASET_META:
         raise ValueError(f"unknown dataset {name!r}")
     shape, class_num, train_n, test_n, task = _DATASET_META[name]
-    real = _try_load_real(name, getattr(args, "data_cache_dir", None))
+    if name in ("imagenet", "gld23k", "gld160k"):
+        # resized-image datasets: stand-in shape follows args.image_size
+        # exactly like the real ingestion, so model example_shape and
+        # data always agree
+        hw = int(getattr(args, "image_size", 64) or 64)
+        shape = (hw, hw, 3)
+    real = _try_load_real(name, getattr(args, "data_cache_dir", None), args)
     if real is not None:
-        x_tr, y_tr, x_te, y_te = real
+        if len(real) == 5:  # loader knows its own class count
+            x_tr, y_tr, x_te, y_te, class_num = real
+        else:
+            x_tr, y_tr, x_te, y_te = real
         return x_tr, y_tr, x_te, y_te, class_num, task
     logging.warning(
         "dataset %s: no local copy under data_cache_dir; using synthetic "
@@ -216,7 +243,7 @@ def load(args) -> FederatedDataset:
             xs_tr.append(x[:k]); ys_tr.append(y[:k])
             xs_te.append(x[k:]); ys_te.append(y[k:])
     elif (
-        fed := _try_load_federated(name, getattr(args, "data_cache_dir", None))
+        fed := _try_load_federated(name, getattr(args, "data_cache_dir", None), args)
     ) is not None:
         # naturally federated: the on-disk per-user split IS the
         # partition (no LDA). Fold users onto the requested client
@@ -241,6 +268,21 @@ def load(args) -> FederatedDataset:
             args.client_num_per_round = min(int(args.client_num_per_round), n_users)
         xs_tr, ys_tr = regroup_clients(xs_tr, ys_tr, client_num)
         xs_te, ys_te = regroup_clients(xs_te, ys_te, client_num)
+        if task == "classification":
+            # custom/differently-truncated copies may carry ids beyond
+            # the canonical class count; widen the head rather than
+            # training silently degenerate one-hots
+            observed = (
+                max((int(y.max()) for y in ys_tr + ys_te if len(y)), default=-1)
+                + 1
+            )
+            if observed > class_num:
+                logging.warning(
+                    "dataset %s: observed class id %d >= canonical class "
+                    "count %d; widening to %d",
+                    name, observed - 1, class_num, observed,
+                )
+                class_num = observed
     else:
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
         if task == "tag_prediction":

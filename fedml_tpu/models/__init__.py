@@ -38,6 +38,10 @@ def _example_shape(args, default=(28, 28, 1)):
         # (synthetic fedprox input_dim; stackoverflow bag-of-words)
         dim = int(getattr(args, "input_dim", 60))
         return (dim,)
+    if ds in ("imagenet", "gld23k", "gld160k"):
+        # resized-image ingestion: H/W follow args.image_size
+        hw = int(getattr(args, "image_size", 64) or 64)
+        return (hw, hw, 3)
     return _IMAGE_SHAPES.get(ds, default)
 
 
@@ -65,12 +69,14 @@ def create(args, output_dim: int) -> FedModel:
             example_shape=_example_shape(args),
         )
     if name == "cnn":
-        if ds in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
+        rgb = ("cifar10", "cifar100", "cinic10", "fed_cifar100",
+               "imagenet", "gld23k", "gld160k")
+        if ds in rgb:
             return FedModel(
                 name="cnn_cifar",
                 module=CNNCifar(output_dim),
                 task="classification",
-                example_shape=(32, 32, 3),
+                example_shape=_example_shape(args, (32, 32, 3)),
             )
         return FedModel(
             name="cnn",

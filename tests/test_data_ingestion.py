@@ -221,6 +221,81 @@ class TestCifarBinary:
         assert y_te.shape == (20,)
 
 
+def _write_png(path, rng, hw=(48, 40)):
+    from PIL import Image
+
+    arr = rng.randint(0, 256, hw + (3,), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+class TestImageFolder:
+    def test_imagenet_style_folder(self, tmp_path, args_factory):
+        rng = np.random.RandomState(0)
+        d = tmp_path / "imagenet"
+        for split, n in (("train", 6), ("val", 2)):
+            for cls in ("n01440764", "n01443537", "n01484850"):
+                cdir = d / split / cls
+                cdir.mkdir(parents=True, exist_ok=True)
+                for i in range(n):
+                    _write_png(str(cdir / f"img_{i}.png"), rng)
+        args = _args(
+            args_factory,
+            dataset="imagenet",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=3,
+            client_num_per_round=3,
+            model="cnn",
+            partition_method="homo",
+            image_size=32,
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.class_num == 3  # folder structure is authoritative
+        assert ds.train_data_num == 18
+        assert ds.test_data_num == 6
+        assert ds.packed_train.x.shape[-3:] == (32, 32, 3)
+        # trains end to end with the class count from the folder
+        model = models.create(args, ds.class_num)
+        api = FedAvgAPI(args, None, ds, model)
+        stats = api.train()
+        assert np.isfinite(stats["train_loss"])
+
+
+class TestLandmarksCsv:
+    def test_user_csv_natural_federation(self, tmp_path, args_factory):
+        import csv
+
+        rng = np.random.RandomState(1)
+        d = tmp_path / "gld23k"
+        (d / "images").mkdir(parents=True)
+        rows = []
+        for u in range(3):
+            for i in range(4 + u):  # ragged users
+                img_id = f"u{u}_img{i}"
+                _write_png(str(d / "images" / f"{img_id}.jpg"), rng)
+                rows.append({"user_id": str(u), "image_id": img_id,
+                             "class": str(rng.randint(0, 5))})
+        with open(d / "train.csv", "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["user_id", "image_id", "class"])
+            w.writeheader()
+            w.writerows(rows)
+        args = _args(
+            args_factory,
+            dataset="gld23k",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=3,
+            client_num_per_round=3,
+            model="cnn",
+            image_size=32,
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 3
+        # natural federation preserved the ragged per-user sizes
+        assert sorted(ds.train_data_local_num_dict.values()) == [4, 5, 6]
+        assert ds.packed_train.x.shape[-3:] == (32, 32, 3)
+
+
 class TestRegroup:
     def test_round_robin_fold(self):
         xs = [np.full((i + 1, 2), i, np.float32) for i in range(5)]
