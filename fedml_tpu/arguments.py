@@ -43,6 +43,9 @@ _DEFAULTS: Dict[str, Any] = {
     # waste_cap x median client size; samples beyond it are truncated
     # (pack_clients logs what was dropped). float("inf") disables.
     "packing_waste_cap": 4.0,
+    # resized-image ingestion (imagenet / gld* folders and CSVs): H=W
+    # decode size; the synthetic stand-ins follow the same knob
+    "image_size": 64,
     # model
     "model": "lr",
     # training
@@ -66,6 +69,10 @@ _DEFAULTS: Dict[str, Any] = {
     # whoever reported within this many seconds of the round broadcast,
     # reweighted over the subset. 0 = wait for everyone (reference).
     "aggregation_deadline_s": 0.0,
+    # elastic membership (cross-silo; beyond the reference): start once
+    # client_num_per_round clients are online, accept mid-run joins,
+    # survive OFFLINE leaves. False = fixed membership (reference).
+    "elastic_membership": False,
     # validation
     "frequency_of_the_test": 5,
     # device

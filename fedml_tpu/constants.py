@@ -64,6 +64,7 @@ MSG_ARG_KEY_MODEL_FILE_URL = "model_file_url"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_STATUS_IDLE = "IDLE"
+CLIENT_STATUS_OFFLINE = "OFFLINE"  # elastic leave (beyond the reference)
 
 # Hierarchical cross-silo intra-silo control plane (reference:
 # cross_silo/hierarchical/client_master_manager.py:239-249 broadcasts

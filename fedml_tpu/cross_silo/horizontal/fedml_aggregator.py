@@ -36,6 +36,7 @@ class FedMLAggregator:
         self.server_aggregator = bind_operator(server_aggregator, model, args)
         self._agg_round = 0
         self.client_num = int(args.client_num_per_round)
+        self._expected = None  # set per round via begin_round (elastic)
         self.model_dict: Dict[int, Params] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
@@ -75,17 +76,42 @@ class FedMLAggregator:
 
     def check_whether_all_receive(self) -> bool:
         """(fedml_aggregator.py:65-71)"""
-        if len(self.flag_client_model_uploaded_dict) < self.client_num:
-            return False
-        for idx in range(self.client_num):
+        expected = (
+            self._expected
+            if self._expected is not None
+            else range(self.client_num)
+        )
+        for idx in expected:
             if not self.flag_client_model_uploaded_dict.get(idx, False):
                 return False
-        for idx in range(self.client_num):
+        for idx in expected:
             self.flag_client_model_uploaded_dict[idx] = False
         return True
 
     def num_received(self) -> int:
         return len(self.model_dict)
+
+    def drop_expected(self, index: int) -> bool:
+        """Remove a leaver's PENDING slot from the current round's
+        expected set (elastic membership). A leaver that already
+        uploaded keeps its slot — its contribution counts and the round
+        completes through the normal path. Returns True only when a
+        pending slot was dropped."""
+        if self._expected is None or index not in self._expected:
+            return False
+        if self.flag_client_model_uploaded_dict.get(index, False):
+            return False  # contribution already in; keep it
+        self._expected.discard(index)
+        self.client_num = len(self._expected)
+        return True
+
+    def begin_round(self, expected_indexes) -> None:
+        """Declare which client indexes this round was broadcast to.
+        With elastic membership the active set is not contiguous
+        (clients join/leave mid-run), so completion is checked against
+        THIS set instead of range(client_num)."""
+        self._expected = set(int(i) for i in expected_indexes)
+        self.client_num = len(self._expected)
 
     def aggregate(self) -> Params:
         """Weighted average of the received models

@@ -108,6 +108,19 @@ class FedMLClientManager(ClientManager):
         )
         self.send_message(msg)
 
+    def leave(self) -> None:
+        """Graceful exit from an elastic federation: announce OFFLINE
+        (the server drops this client from the current round's expected
+        set and future selections) and stop the receive loop."""
+        msg = Message(
+            constants.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, self.server_rank
+        )
+        msg.add_params(
+            constants.MSG_ARG_KEY_CLIENT_STATUS, constants.CLIENT_STATUS_OFFLINE
+        )
+        self.send_message(msg)
+        self.finish()
+
     def handle_message_init(self, msg: Message) -> None:
         self._train_and_send(msg)
 

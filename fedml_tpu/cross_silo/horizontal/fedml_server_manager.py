@@ -23,6 +23,15 @@ timer per round; when it fires it aggregates whoever reported by then
 their round tag and are discarded with a log line. The timer thread
 never touches state directly — it posts a message to the server's own
 inbox, so all mutation stays on the single dispatch thread.
+
+**Beyond the reference — elastic membership**: with
+``args.elastic_membership`` the federation starts as soon as
+``client_num_per_round`` clients are ONLINE, accepts late joins (a new
+rank's ONLINE registers it; it trains from the next round), and
+handles OFFLINE leaves mid-round (the leaver's slot is dropped from
+the round's expected set so the federation never stalls on it). The
+reference blocks round 0 until every configured client appears and has
+no membership changes after that (fedml_server_manager.py:95-119).
 """
 
 from __future__ import annotations
@@ -89,6 +98,14 @@ class FedMLServerManager(ServerManager):
         self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
         self._deadline_timer = None
         self.stragglers_dropped = 0
+        self.elastic = bool(getattr(args, "elastic_membership", False))
+        if self.elastic and getattr(args, "client_id_list", None):
+            raise ValueError(
+                "elastic_membership assigns real ids dynamically (rank = "
+                "id); it cannot be combined with a fixed client_id_list"
+            )
+        self.joins = 0
+        self.leaves = 0
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -105,18 +122,61 @@ class FedMLServerManager(ServerManager):
             self.handle_message_deadline,
         )
 
+    def _active_ranks(self):
+        return [r for r, on in sorted(self.client_online_status.items()) if on]
+
     def handle_message_client_status_update(self, msg: Message) -> None:
-        """(fedml_server_manager.py:95-119)"""
+        """(fedml_server_manager.py:95-119) + elastic join/leave."""
         status = msg.get(constants.MSG_ARG_KEY_CLIENT_STATUS)
+        sender = int(msg.get_sender_id())
         if status == constants.CLIENT_STATUS_ONLINE:
-            self.client_online_status[int(msg.get_sender_id())] = True
-        all_online = all(
-            self.client_online_status.get(rank, False)
-            for rank in range(1, len(self.client_real_ids) + 1)
-        )
-        if all_online and not self.is_initialized:
-            self.is_initialized = True
-            self.send_init_msg()
+            known = 1 <= sender <= len(self.client_real_ids)
+            if not known:
+                if not self.elastic:
+                    logging.warning(
+                        "ONLINE from unknown rank %d ignored (set "
+                        "elastic_membership to accept joins)", sender,
+                    )
+                    return
+                # register ranks up to the newcomer (real id = rank)
+                for r in range(len(self.client_real_ids) + 1, sender + 1):
+                    self.client_real_ids.append(r)
+                    self._rank_of_real_id[r] = r
+            self.client_online_status[sender] = True
+            if self.is_initialized:
+                if self.elastic:
+                    self.joins += 1
+                    logging.info(
+                        "elastic join: rank %d online at round %d "
+                        "(participates from the next broadcast)",
+                        sender, self.round_idx,
+                    )
+                return
+            if self.elastic:
+                ready = len(self._active_ranks()) >= int(
+                    self.args.client_num_per_round
+                )
+            else:
+                ready = all(
+                    self.client_online_status.get(rank, False)
+                    for rank in range(1, len(self.client_real_ids) + 1)
+                )
+            if ready:
+                self.is_initialized = True
+                self.send_init_msg()
+        elif status == constants.CLIENT_STATUS_OFFLINE:
+            if not self.elastic:
+                logging.warning("OFFLINE from rank %d ignored (non-elastic)", sender)
+                return
+            self.client_online_status[sender] = False
+            self.leaves += 1
+            logging.info(
+                "elastic leave: rank %d offline at round %d", sender, self.round_idx
+            )
+            if self.is_initialized and self.aggregator.drop_expected(sender - 1):
+                # the round was only waiting on the leaver
+                if self.aggregator.check_whether_all_receive():
+                    self._finish_round()
 
     def send_init_msg(self) -> None:
         """(fedml_server_manager.py:47-69)"""
@@ -127,22 +187,45 @@ class FedMLServerManager(ServerManager):
         (fedml_server_manager.py:47-69 and :167-207): pick which edge
         ranks participate (``client_selection``), map them onto data-silo
         indices (``data_silo_selection``), send the global model."""
+        if self.elastic:
+            # membership is whoever is online right now; selection caps
+            # at client_num_per_round of them
+            candidate_ids = [self.client_real_ids[r - 1] for r in self._active_ranks()]
+            n_select = min(
+                int(self.args.client_num_per_round), len(candidate_ids)
+            )
+        else:
+            candidate_ids = self.client_real_ids
+            n_select = len(candidate_ids)
         selected_real_ids = self.aggregator.client_selection(
-            self.round_idx, self.client_real_ids, len(self.client_real_ids)
+            self.round_idx, candidate_ids, n_select
         )
         silo_indexes = self.aggregator.data_silo_selection(
             self.round_idx,
             int(self.args.client_num_in_total),
             len(selected_real_ids),
         )
+        if not selected_real_ids:
+            # an empty federation cannot progress; shut down loudly
+            # instead of blocking forever on an inbox nobody feeds
+            logging.error(
+                "round %d: no online clients to broadcast to; finishing",
+                self.round_idx,
+            )
+            self.send_finish()
+            self.finish()
+            return
         global_params = self.aggregator.get_global_model_params()
+        expected = []
         for real_id, silo_idx in zip(selected_real_ids, silo_indexes):
             rank = self._rank_of_real_id[real_id]
+            expected.append(rank - 1)
             msg = Message(msg_type, self.rank, rank)
             msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
             self.send_message(msg)
+        self.aggregator.begin_round(expected)
         self._arm_deadline()
 
     # -- deadline cohort (beyond the reference) -----------------------
@@ -239,9 +322,17 @@ class FedMLServerManager(ServerManager):
             self.profiler.log_event_ended("server.wait")
             self._wait_open = False
         n_aggregated = self.aggregator.num_received()
-        with self.profiler.span("aggregate"):
-            self.aggregator.aggregate()
-        self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        if n_aggregated:
+            with self.profiler.span("aggregate"):
+                self.aggregator.aggregate()
+            self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        else:
+            # every expected client left before uploading (elastic):
+            # the global model is unchanged this round; keep going
+            logging.warning(
+                "round %d: no contributions (all expected clients left); "
+                "global model unchanged", self.round_idx,
+            )
         self.metrics_reporter.report(
             {
                 "kind": "round_info",

@@ -160,7 +160,7 @@ def _try_load_federated(name: str, cache_dir: str, args=None):
     if out is None and ingest.tff_h5_available(d, name):
         out = ingest.load_tff_h5(d, name)
     if out is None and ingest.landmarks_csv_available(d):
-        hw = int(getattr(args, "image_size", 0) or shape[0])
+        hw = int(getattr(args, "image_size", 64) or 64)
         out = ingest.load_landmarks_csv(d, (hw, hw))
     if out is None:
         return None
@@ -285,6 +285,20 @@ def load(args) -> FederatedDataset:
                 class_num = observed
     else:
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
+        if task == "classification":
+            # npz/CIFAR drop-ins may carry ids beyond the canonical
+            # class count; widen the head (same policy as the
+            # naturally-federated branch)
+            observed = int(max(
+                y_tr.max(initial=-1), y_te.max(initial=-1)
+            )) + 1
+            if observed > class_num:
+                logging.warning(
+                    "dataset %s: observed class id %d >= canonical class "
+                    "count %d; widening to %d",
+                    name, observed - 1, class_num, observed,
+                )
+                class_num = observed
         if task == "tag_prediction":
             # model factory sizes the input layer off args (the bow dim
             # differs between real data and the synthetic stand-in)

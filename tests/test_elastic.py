@@ -1,0 +1,166 @@
+"""Elastic membership (beyond the reference): mid-run join and leave.
+
+The reference blocks round 0 until every configured client appears and
+has no membership changes after that (fedml_server_manager.py:95-119).
+With args.elastic_membership the federation starts at quorum
+(client_num_per_round online), a late client joins and trains from the
+next broadcast, and an OFFLINE leave mid-round never stalls a round.
+"""
+
+import threading
+import time
+
+import pytest
+
+import fedml_tpu
+from fedml_tpu import constants, models
+from fedml_tpu.cross_silo import Client, Server
+from fedml_tpu.data import load
+
+
+def _mk(make, run_id, **kw):
+    base = dict(
+        training_type="cross_silo",
+        dataset="mnist",
+        synthetic_train_size=300,
+        synthetic_test_size=60,
+        model="lr",
+        client_num_in_total=3,
+        client_num_per_round=2,
+        comm_round=10,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=5,
+        shuffle=False,
+        backend="LOCAL",
+        run_id=run_id,
+        elastic_membership=True,
+    )
+    base.update(kw)
+    return make(**base)
+
+
+def _build(args_factory, run_id, rank, **kw):
+    a = _mk(args_factory, run_id, **kw)
+    a.rank = rank
+    a = fedml_tpu.init(a)
+    ds = load(a)
+    m = models.create(a, ds.class_num)
+    return a, ds, m
+
+
+class TestElasticJoin:
+    def test_late_client_joins_and_trains(self, args_factory):
+        a0, ds0, m0 = _build(args_factory, "elastic_join", 0)
+        server = Server(a0, None, ds0, m0)
+
+        clients = []
+        for r in (1, 2, 3):
+            a, ds, m = _build(args_factory, "elastic_join", r)
+            clients.append(Client(a, None, ds, m))
+
+        # instrument the late client so participation is observable
+        late = clients[2]
+        late_calls = []
+        orig_train = late.trainer.train
+        late.trainer.train = lambda p, r: (late_calls.append(r), orig_train(p, r))[1]
+
+        # join is gated on an OBSERVED event (first round completed),
+        # not wall clock, and the early clients pace the rounds so the
+        # joiner's ONLINE always lands mid-federation
+        first_round_done = threading.Event()
+        orig_finish = server.manager._finish_round
+
+        def finish_hook():
+            first_round_done.set()
+            orig_finish()
+
+        server.manager._finish_round = finish_hook
+        for c in clients[:2]:
+            orig = c.trainer.train
+            c.trainer.train = (
+                lambda p, r, _o=orig: (time.sleep(0.2), _o(p, r))[1]
+            )
+
+        def run_late():
+            assert first_round_done.wait(timeout=120)
+            late.run()
+
+        threads = [
+            threading.Thread(target=clients[0].run, daemon=True),
+            threading.Thread(target=clients[1].run, daemon=True),
+            threading.Thread(target=run_late, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert server.manager.round_idx == 10
+        assert server.manager.joins == 1
+        # the joiner was selected and trained at least once (10 rounds,
+        # 2-of-3 selection after it joins: miss-every-round prob ~ 1e-4)
+        assert len(late_calls) >= 1
+        assert not any(t.is_alive() for t in threads), "clients hung"
+
+    def test_nonelastic_ignores_unknown_rank(self, args_factory):
+        from fedml_tpu.cross_silo.horizontal.fedml_server_manager import (
+            FedMLServerManager,
+        )
+        from fedml_tpu.cross_silo.horizontal.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.core.message import Message
+
+        a = _mk(args_factory, "ne1", elastic_membership=False,
+                client_num_per_round=2)
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        mgr = FedMLServerManager(
+            a, FedMLAggregator(a, m), rank=0, size=3, backend="LOCAL"
+        )
+        msg = Message(constants.MSG_TYPE_C2S_CLIENT_STATUS, 99, 0)
+        msg.add_params(
+            constants.MSG_ARG_KEY_CLIENT_STATUS, constants.CLIENT_STATUS_ONLINE
+        )
+        mgr.handle_message_client_status_update(msg)
+        assert not mgr.is_initialized
+        assert 99 not in mgr.client_online_status
+
+
+class TestElasticLeave:
+    def test_leaver_does_not_stall_round(self, args_factory):
+        a0, ds0, m0 = _build(
+            args_factory, "elastic_leave", 0,
+            client_num_per_round=3, comm_round=4,
+        )
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in (1, 2, 3):
+            a, ds, m = _build(
+                args_factory, "elastic_leave", r,
+                client_num_per_round=3, comm_round=4,
+            )
+            clients.append(Client(a, None, ds, m))
+
+        # client 2 trains round 0 then leaves instead of training again
+        leaver = clients[1]
+        orig = leaver.manager._train_and_send
+
+        def train_or_leave(msg):
+            if int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0)) == 0:
+                orig(msg)
+            else:
+                leaver.manager.leave()
+
+        leaver.manager._train_and_send = train_or_leave
+
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert server.manager.round_idx == 4  # never stalled
+        assert server.manager.leaves == 1
+        assert not any(t.is_alive() for t in threads), "clients hung"
