@@ -138,6 +138,15 @@ class FedMLServerManager(ServerManager):
                         "elastic_membership to accept joins)", sender,
                     )
                     return
+                max_clients = int(getattr(self.args, "max_clients", 4096))
+                if sender < 1 or sender > max_clients:
+                    # one misconfigured hello must not bloat server
+                    # state with ghost ranks
+                    logging.error(
+                        "ONLINE from rank %d rejected (max_clients=%d)",
+                        sender, max_clients,
+                    )
+                    return
                 # register ranks up to the newcomer (real id = rank)
                 for r in range(len(self.client_real_ids) + 1, sender + 1):
                     self.client_real_ids.append(r)

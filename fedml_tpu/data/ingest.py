@@ -381,3 +381,75 @@ def load_landmarks_csv(
         data_dir, n, sum(len(y) for y in ys_tr),
     )
     return xs_tr, ys_tr, xs_te, ys_te
+
+
+# -- vertical-FL party CSVs -------------------------------------------
+
+
+def vfl_party_csvs_available(data_dir: str) -> bool:
+    """NUS-WIDE / lending-club style party split: party_0.csv (guest,
+    carries the label column) + party_1.csv.. (host features)."""
+    return os.path.isfile(os.path.join(data_dir, "party_0.csv"))
+
+
+def load_vfl_party_csvs(
+    data_dir: str,
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Row-aligned party feature CSVs -> ([feats_k [N, d_k]...], labels).
+
+    Reference analog: the vertically-split finance/CV datasets
+    (``data/NUS_WIDE/``, ``data/lending_club_loan/``, ``data/UCI/``)
+    where each organization holds its own feature columns for the same
+    sample population. party_0.csv must carry the label column
+    (``label`` or ``y``, case-insensitive); an ``id`` column, if
+    present, is dropped everywhere (rows must already be aligned —
+    private set intersection is upstream of ingestion)."""
+    import csv as _csv
+
+    feats: List[np.ndarray] = []
+    labels: Optional[np.ndarray] = None
+    k = 0
+    while os.path.isfile(os.path.join(data_dir, f"party_{k}.csv")):
+        with open(os.path.join(data_dir, f"party_{k}.csv")) as f:
+            rows = list(_csv.DictReader(f))
+        if not rows:
+            raise ValueError(f"party_{k}.csv has no data rows")
+        cols = list(rows[0].keys())
+        # only the guest (party_0) carries labels; a host column that
+        # happens to be named 'label'/'y' is an ordinary feature
+        label_col = (
+            next((c for c in cols if c.lower() in ("label", "y")), None)
+            if k == 0
+            else None
+        )
+        if k == 0 and label_col is None:
+            raise ValueError("party_0.csv must carry a 'label' (or 'y') column")
+        feat_cols = [
+            c for c in cols if c != label_col and c.lower() != "id"
+        ]
+        feats.append(
+            np.asarray(
+                [[float(r[c]) for c in feat_cols] for r in rows], np.float32
+            )
+        )
+        if label_col is not None:
+            labels = np.asarray([int(float(r[label_col])) for r in rows], np.int64)
+            if labels.min() < 0:
+                raise ValueError(
+                    "party_0.csv labels must be non-negative class ids "
+                    "(found %d); re-encode -1/+1 style labels as 0/1"
+                    % labels.min()
+                )
+        k += 1
+    n = len(feats[0])
+    for i, fmat in enumerate(feats):
+        if len(fmat) != n:
+            raise ValueError(
+                f"party_{i}.csv has {len(fmat)} rows, party_0 has {n}; "
+                "party files must be row-aligned"
+            )
+    logging.info(
+        "vfl party csvs %s: %d parties, %d samples, dims %s",
+        data_dir, k, n, [f.shape[1] for f in feats],
+    )
+    return feats, labels

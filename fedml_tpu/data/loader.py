@@ -172,6 +172,20 @@ def _try_load_federated(name: str, cache_dir: str, args=None):
     return xs_tr, ys_tr, xs_te, ys_te
 
 
+
+def _widen_class_num(name: str, class_num: int, observed: int) -> int:
+    """Custom/truncated on-disk copies may carry ids beyond the
+    canonical class count; widen the head rather than training silently
+    degenerate one-hots."""
+    if observed > class_num:
+        logging.warning(
+            "dataset %s: observed class id %d >= canonical class count "
+            "%d; widening to %d", name, observed - 1, class_num, observed,
+        )
+        return observed
+    return class_num
+
+
 def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, str]:
     name = getattr(args, "dataset", "synthetic").lower()
     seed = int(getattr(args, "random_seed", 0))
@@ -269,36 +283,16 @@ def load(args) -> FederatedDataset:
         xs_tr, ys_tr = regroup_clients(xs_tr, ys_tr, client_num)
         xs_te, ys_te = regroup_clients(xs_te, ys_te, client_num)
         if task == "classification":
-            # custom/differently-truncated copies may carry ids beyond
-            # the canonical class count; widen the head rather than
-            # training silently degenerate one-hots
             observed = (
                 max((int(y.max()) for y in ys_tr + ys_te if len(y)), default=-1)
                 + 1
             )
-            if observed > class_num:
-                logging.warning(
-                    "dataset %s: observed class id %d >= canonical class "
-                    "count %d; widening to %d",
-                    name, observed - 1, class_num, observed,
-                )
-                class_num = observed
+            class_num = _widen_class_num(name, class_num, observed)
     else:
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
         if task == "classification":
-            # npz/CIFAR drop-ins may carry ids beyond the canonical
-            # class count; widen the head (same policy as the
-            # naturally-federated branch)
-            observed = int(max(
-                y_tr.max(initial=-1), y_te.max(initial=-1)
-            )) + 1
-            if observed > class_num:
-                logging.warning(
-                    "dataset %s: observed class id %d >= canonical class "
-                    "count %d; widening to %d",
-                    name, observed - 1, class_num, observed,
-                )
-                class_num = observed
+            observed = int(max(y_tr.max(initial=-1), y_te.max(initial=-1))) + 1
+            class_num = _widen_class_num(name, class_num, observed)
         if task == "tag_prediction":
             # model factory sizes the input layer off args (the bow dim
             # differs between real data and the synthetic stand-in)

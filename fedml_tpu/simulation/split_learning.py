@@ -505,10 +505,22 @@ class VFLAPI:
         lr = float(getattr(args, "learning_rate", 0.05))
         self.epochs = int(getattr(args, "epochs", 1))
 
-        # vertically partition the centralized training features
-        tr, te = dataset.train_data_global, dataset.test_data_global
-        self._train = self._split_batches(tr)
-        self._test = self._split_batches(te)
+        real = self._try_load_party_csvs(args)
+        if real is not None:
+            # real vertically-partitioned data (NUS-WIDE / lending-club
+            # style party CSVs): each organization's feature columns ARE
+            # the vertical split — no synthetic column slicing
+            feats, labels = real
+            self.n_parties = len(feats)
+            cls = max(cls, int(labels.max()) + 1)
+            self._train, self._test = self._pack_party_data(
+                feats, labels, int(getattr(args, "batch_size", 32))
+            )
+        else:
+            # vertically partition the centralized training features
+            tr, te = dataset.train_data_global, dataset.test_data_global
+            self._train = self._split_batches(tr)
+            self._test = self._split_batches(te)
 
         self.party_net = PartyLocalModel(output_dim=rep_dim)
         self.top_net = GuestTopModel(output_dim=cls)
@@ -525,6 +537,61 @@ class VFLAPI:
         self.opt_states = [self.opt.init(p) for p in self.party_params]
         self.opt_top_state = self.opt.init(self.top_params)
         self._build_jitted()
+
+    @staticmethod
+    def _try_load_party_csvs(args):
+        import os
+
+        cache = getattr(args, "data_cache_dir", None)
+        name = getattr(args, "dataset", "")
+        if not cache or not name:
+            return None
+        d = os.path.join(cache, name)
+        from ..data.ingest import load_vfl_party_csvs, vfl_party_csvs_available
+
+        if not vfl_party_csvs_available(d):
+            return None
+        return load_vfl_party_csvs(d)
+
+    def _pack_party_data(self, feats, labels, batch_size: int):
+        """Row-aligned party arrays -> two (xs, y, mask) batch sets
+        (80/20 train/test split on the shared row axis)."""
+        n = len(labels)
+        # seeded row shuffle first: published party extracts are often
+        # label-sorted, which would make an ordered 80/20 split
+        # degenerate (single-class test set)
+        perm = np.random.RandomState(
+            int(getattr(self.args, "random_seed", 0))
+        ).permutation(n)
+        feats = [f[perm] for f in feats]
+        labels = labels[perm]
+        n_tr = max(1, int(0.8 * n))
+
+        def pack(lo, hi):
+            m = hi - lo
+            nb = max(1, -(-m // batch_size))
+            pad = nb * batch_size - m
+            xs = []
+            for f in feats:
+                sl = f[lo:hi]
+                if pad:
+                    sl = np.concatenate(
+                        [sl, np.zeros((pad,) + sl.shape[1:], sl.dtype)]
+                    )
+                xs.append(jnp.asarray(sl.reshape(nb, batch_size, -1)))
+            y = labels[lo:hi]
+            if pad:
+                y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            mask = np.concatenate(
+                [np.ones(m, np.float32), np.zeros(pad, np.float32)]
+            )
+            return (
+                xs,
+                jnp.asarray(y.reshape(nb, batch_size)),
+                jnp.asarray(mask.reshape(nb, batch_size)),
+            )
+
+        return pack(0, n_tr), pack(n_tr, n)
 
     def _split_batches(self, b: Batches):
         """[nb, bs, ...] -> (party feature slices [nb, bs, d_k], y, mask)."""
