@@ -224,6 +224,7 @@ def _default_config_path(training_type: str) -> Optional[str]:
     name = {
         constants.FEDML_TRAINING_PLATFORM_SIMULATION: "simulation_sp.yaml",
         constants.FEDML_TRAINING_PLATFORM_CROSS_SILO: "cross_silo.yaml",
+        constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE: "cross_device.yaml",
     }.get(training_type)
     if name is None:
         return None

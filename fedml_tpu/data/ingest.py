@@ -406,6 +406,19 @@ def load_vfl_party_csvs(
     private set intersection is upstream of ingestion)."""
     import csv as _csv
 
+    import glob as _glob
+    import re as _re
+
+    present = sorted(
+        int(m.group(1))
+        for p in _glob.glob(os.path.join(data_dir, "party_*.csv"))
+        if (m := _re.fullmatch(r"party_(\d+)\.csv", os.path.basename(p)))
+    )
+    if present != list(range(len(present))):
+        raise ValueError(
+            f"party CSVs in {data_dir} must be contiguously numbered "
+            f"party_0..party_K; found indices {present}"
+        )
     feats: List[np.ndarray] = []
     labels: Optional[np.ndarray] = None
     k = 0

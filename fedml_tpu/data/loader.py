@@ -97,6 +97,10 @@ class FederatedDataset:
     packed_test: Optional[Batches] = None
     client_num: int = 0
     task: str = "classification"
+    # vertically-partitioned source (party CSVs): ([feats_k [N,d_k]...],
+    # labels [N]). The VFL scenario uses the real per-party columns as
+    # the vertical split; horizontal consumers see the concatenation.
+    vfl_parties: Optional[Tuple[List[np.ndarray], np.ndarray]] = None
 
     def to_list(self) -> List:
         """Reference 8-tuple (data_loader.py:310-320)."""
@@ -238,6 +242,16 @@ def load(args) -> FederatedDataset:
     client_num = int(args.client_num_in_total)
     batch_size = int(args.batch_size)
     seed = int(getattr(args, "random_seed", 0))
+
+    # vertically-partitioned party CSVs (NUS-WIDE / lending-club style)
+    # take priority for ANY dataset name — the files define the data
+    cache = getattr(args, "data_cache_dir", None)
+    if cache:
+        from .ingest import vfl_party_csvs_available
+
+        vfl_dir = os.path.join(cache, name)
+        if vfl_party_csvs_available(vfl_dir):
+            return _load_vfl_dataset(args, vfl_dir, client_num, batch_size, seed)
 
     if name.startswith("synthetic"):
         xs, ys = synthetic_fedprox(
@@ -387,3 +401,61 @@ def load(args) -> FederatedDataset:
 
 def _client_view(stacked: Batches, i: int) -> Batches:
     return Batches(x=stacked.x[i], y=stacked.y[i], mask=stacked.mask[i])
+
+
+def _load_vfl_dataset(
+    args, vfl_dir: str, client_num: int, batch_size: int, seed: int
+) -> FederatedDataset:
+    """Party CSVs -> FederatedDataset. The per-party arrays ride on
+    ``vfl_parties`` for the VFL scenario; horizontal consumers get the
+    column-concatenated features (homo partition — vertical data has no
+    per-client label skew by construction)."""
+    from .ingest import load_vfl_party_csvs
+
+    feats, labels = load_vfl_party_csvs(vfl_dir)
+    class_num = int(labels.max()) + 1
+    x_all = np.concatenate([f.reshape(len(f), -1) for f in feats], axis=1)
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(labels))
+    x_all, labels_sh = x_all[perm], labels[perm]
+    n_tr = max(1, int(0.8 * len(labels_sh)))
+    x_tr, y_tr = x_all[:n_tr], labels_sh[:n_tr]
+    x_te, y_te = x_all[n_tr:], labels_sh[n_tr:]
+    args.input_dim = int(x_all.shape[1])
+
+    idx_map = homo_partition(len(y_tr), client_num, seed)
+    te_map = homo_partition(len(y_te), client_num, seed + 1)
+    xs_tr = [x_tr[idx_map[i]] for i in range(client_num)]
+    ys_tr = [y_tr[idx_map[i]] for i in range(client_num)]
+    xs_te = [x_te[te_map[i]] for i in range(client_num)]
+    ys_te = [y_te[te_map[i]] for i in range(client_num)]
+
+    import jax.numpy as jnp
+
+    sizes = [len(x) for x in xs_tr]
+    nb = bucket_num_batches(sizes, batch_size)
+    packed_train, num_samples = pack_clients(xs_tr, ys_tr, batch_size, num_batches=nb)
+    nb_te = bucket_num_batches([len(x) for x in xs_te], batch_size)
+    packed_test, _ = pack_clients(xs_te, ys_te, batch_size, num_batches=nb_te)
+    train_global = pack_one(x_tr, y_tr, batch_size)
+    test_global = pack_one(x_te, y_te, batch_size)
+    return FederatedDataset(
+        train_data_num=int(len(y_tr)),
+        test_data_num=int(len(y_te)),
+        train_data_global=train_global,
+        test_data_global=test_global,
+        train_data_local_num_dict={i: int(s) for i, s in enumerate(sizes)},
+        train_data_local_dict={
+            i: _client_view(packed_train, i) for i in range(client_num)
+        },
+        test_data_local_dict={
+            i: _client_view(packed_test, i) for i in range(client_num)
+        },
+        class_num=class_num,
+        packed_train=packed_train,
+        packed_num_samples=np.asarray(num_samples),
+        packed_test=packed_test,
+        client_num=client_num,
+        task="classification",
+        vfl_parties=(feats, labels),
+    )

@@ -505,7 +505,10 @@ class VFLAPI:
         lr = float(getattr(args, "learning_rate", 0.05))
         self.epochs = int(getattr(args, "epochs", 1))
 
-        real = self._try_load_party_csvs(args)
+        # the loader attaches real party data when party CSVs exist
+        # under data_cache_dir/<dataset>; direct construction without
+        # load() falls back to probing the path itself
+        real = getattr(dataset, "vfl_parties", None) or self._try_load_party_csvs(args)
         if real is not None:
             # real vertically-partitioned data (NUS-WIDE / lending-club
             # style party CSVs): each organization's feature columns ARE
@@ -543,7 +546,7 @@ class VFLAPI:
         import os
 
         cache = getattr(args, "data_cache_dir", None)
-        name = getattr(args, "dataset", "")
+        name = getattr(args, "dataset", "").lower()
         if not cache or not name:
             return None
         d = os.path.join(cache, name)

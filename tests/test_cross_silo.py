@@ -169,6 +169,38 @@ class TestCrossSiloLocal:
         )
 
 
+class TestCrossSiloMqtt:
+    def test_mqtt_matches_local(self, args_factory):
+        """Transport matrix completeness: the pub/sub broker backend
+        produces the same global model as LOCAL (like gRPC and TRPC)."""
+        s1 = _run_world(
+            args_factory,
+            run_id="csmq1",
+            backend="MQTT",
+            comm_round=2,
+            client_num_in_total=3,
+            client_num_per_round=3,
+            n_clients=3,
+            broker_port=_free_port_block(1),
+        )
+        s2 = _run_world(
+            args_factory,
+            run_id="csmq2",
+            backend="LOCAL",
+            comm_round=2,
+            client_num_in_total=3,
+            client_num_per_round=3,
+            n_clients=3,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            s1.aggregator.get_global_model_params(),
+            s2.aggregator.get_global_model_params(),
+        )
+
+
 class TestCrossSiloGrpc:
     def test_round_loop_over_grpc(self, args_factory):
         base = _free_port_block(4)

@@ -329,10 +329,13 @@ class TestVflPartyCsv:
         np.testing.assert_array_equal(labels, y)
 
     def test_vfl_api_consumes_party_csvs(self, tmp_path, args_factory):
+        """The NORMAL entry path: load(args) detects the party CSVs for
+        any dataset name and the VFL engine uses the real per-party
+        columns as the vertical split."""
         self._write_parties(tmp_path / "nus_wide")
         args = _args(
             args_factory,
-            dataset="nus_wide",  # not in _DATASET_META: VFL reads CSVs
+            dataset="nus_wide",
             federated_optimizer="VFL",
             data_cache_dir=str(tmp_path),
             comm_round=8,
@@ -340,21 +343,39 @@ class TestVflPartyCsv:
             learning_rate=0.3,
             frequency_of_the_test=1,
         )
-        # bypass load() (dataset name is VFL-private); build a minimal
-        # synthetic FederatedDataset for the class_num fallback
-        args.dataset = "mnist"
-        args.synthetic_train_size = 64
-        args.synthetic_test_size = 16
         args = fedml_tpu.init(args)
-        ds = load(args)
-        args.dataset = "nus_wide"
-        from fedml_tpu.simulation.split_learning import VFLAPI
+        ds = load(args)  # no _DATASET_META entry needed: CSVs define it
+        assert ds.vfl_parties is not None
+        assert ds.class_num == 2  # from the labels, not any meta table
+        from fedml_tpu.simulation.simulator import SimulatorSingleProcess
 
-        api = VFLAPI(args, None, ds)
+        model = models.create(args, ds.class_num)
+        sim = SimulatorSingleProcess(args, None, ds, model)
+        api = sim.fl_trainer
         assert api.n_parties == 3  # from the party files, not vfl_parties
-        stats = api.train()
+        stats = sim.run()
         assert np.isfinite(stats["train_loss"])
         assert stats["test_acc"] > 0.6  # the split features are informative
+
+    def test_party_csv_gap_rejected(self, tmp_path):
+        import csv
+
+        from fedml_tpu.data.ingest import load_vfl_party_csvs
+
+        d = tmp_path / "gappy"
+        d.mkdir()
+        for k in (0, 1, 3):  # party_2 missing
+            with open(d / f"party_{k}.csv", "w", newline="") as f:
+                w = csv.DictWriter(
+                    f, fieldnames=(["label"] if k == 0 else []) + ["x0"]
+                )
+                w.writeheader()
+                row = {"x0": "1.0"}
+                if k == 0:
+                    row["label"] = "0"
+                w.writerow(row)
+        with pytest.raises(ValueError, match="contiguously"):
+            load_vfl_party_csvs(str(d))
 
 
 class TestRegroup:
