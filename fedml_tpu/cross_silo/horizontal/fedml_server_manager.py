@@ -334,7 +334,6 @@ class FedMLServerManager(ServerManager):
         if n_aggregated:
             with self.profiler.span("aggregate"):
                 self.aggregator.aggregate()
-            self.aggregator.test_on_server_for_all_clients(self.round_idx)
         else:
             # every expected client left before uploading (elastic):
             # the global model is unchanged this round; keep going
@@ -342,20 +341,36 @@ class FedMLServerManager(ServerManager):
                 "round %d: no contributions (all expected clients left); "
                 "global model unchanged", self.round_idx,
             )
-        self.metrics_reporter.report(
-            {
-                "kind": "round_info",
-                "round": self.round_idx,
-                "clients": self.aggregator.client_num,
-                "clients_aggregated": n_aggregated,
-            }
-        )
+        eval_round = self.round_idx
+        cohort = self.aggregator.client_num  # before begin_round re-arms
         self.round_idx += 1
         if self.round_idx >= self.round_num:
+            if n_aggregated:
+                self.aggregator.test_on_server_for_all_clients(eval_round)
+            self._report_round(eval_round, cohort, n_aggregated)
             self.send_finish()
             self.finish()
             return
+        # comm/compute overlap (SURVEY.md §7 "the round loop must
+        # overlap comm and compute explicitly"; the reference evals
+        # before syncing, stalling every client for the server's eval):
+        # broadcast the next round FIRST so clients train while the
+        # server evaluates the round that just closed.
         self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+        if n_aggregated:
+            with self.profiler.span("server_eval_overlapped"):
+                self.aggregator.test_on_server_for_all_clients(eval_round)
+        self._report_round(eval_round, cohort, n_aggregated)
+
+    def _report_round(self, round_idx: int, cohort: int, n_aggregated: int) -> None:
+        self.metrics_reporter.report(
+            {
+                "kind": "round_info",
+                "round": round_idx,
+                "clients": cohort,
+                "clients_aggregated": n_aggregated,
+            }
+        )
 
     def send_finish(self) -> None:
         for rank in range(1, len(self.client_real_ids) + 1):

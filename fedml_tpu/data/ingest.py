@@ -414,6 +414,8 @@ def load_vfl_party_csvs(
         for p in _glob.glob(os.path.join(data_dir, "party_*.csv"))
         if (m := _re.fullmatch(r"party_(\d+)\.csv", os.path.basename(p)))
     )
+    if not present:
+        raise ValueError(f"no party_K.csv files under {data_dir}")
     if present != list(range(len(present))):
         raise ValueError(
             f"party CSVs in {data_dir} must be contiguously numbered "
@@ -421,8 +423,7 @@ def load_vfl_party_csvs(
         )
     feats: List[np.ndarray] = []
     labels: Optional[np.ndarray] = None
-    k = 0
-    while os.path.isfile(os.path.join(data_dir, f"party_{k}.csv")):
+    for k in present:
         with open(os.path.join(data_dir, f"party_{k}.csv")) as f:
             rows = list(_csv.DictReader(f))
         if not rows:
@@ -453,7 +454,7 @@ def load_vfl_party_csvs(
                     "(found %d); re-encode -1/+1 style labels as 0/1"
                     % labels.min()
                 )
-        k += 1
+    k = len(present)
     n = len(feats[0])
     for i, fmat in enumerate(feats):
         if len(fmat) != n:
@@ -466,3 +467,26 @@ def load_vfl_party_csvs(
         data_dir, k, n, [f.shape[1] for f in feats],
     )
     return feats, labels
+
+
+def vfl_train_test_split(
+    feats: List[np.ndarray], labels: np.ndarray, seed: int, train_frac: float = 0.8
+):
+    """THE canonical row split for vertically-partitioned data — both
+    the loader's horizontal view and the VFL engine's party view must
+    use this one function or their test rows would silently diverge
+    (train/test leakage between the two views of the same CSVs).
+    Returns (feats_tr, labels_tr, feats_te, labels_te), row-shuffled
+    with a seeded permutation (published extracts are often
+    label-sorted)."""
+    n = len(labels)
+    perm = np.random.RandomState(int(seed)).permutation(n)
+    feats = [f[perm] for f in feats]
+    labels = labels[perm]
+    n_tr = max(1, int(train_frac * n))
+    return (
+        [f[:n_tr] for f in feats],
+        labels[:n_tr],
+        [f[n_tr:] for f in feats],
+        labels[n_tr:],
+    )

@@ -410,18 +410,14 @@ def _load_vfl_dataset(
     ``vfl_parties`` for the VFL scenario; horizontal consumers get the
     column-concatenated features (homo partition — vertical data has no
     per-client label skew by construction)."""
-    from .ingest import load_vfl_party_csvs
+    from .ingest import load_vfl_party_csvs, vfl_train_test_split
 
     feats, labels = load_vfl_party_csvs(vfl_dir)
     class_num = int(labels.max()) + 1
-    x_all = np.concatenate([f.reshape(len(f), -1) for f in feats], axis=1)
-    rng = np.random.RandomState(seed)
-    perm = rng.permutation(len(labels))
-    x_all, labels_sh = x_all[perm], labels[perm]
-    n_tr = max(1, int(0.8 * len(labels_sh)))
-    x_tr, y_tr = x_all[:n_tr], labels_sh[:n_tr]
-    x_te, y_te = x_all[n_tr:], labels_sh[n_tr:]
-    args.input_dim = int(x_all.shape[1])
+    f_tr, y_tr, f_te, y_te = vfl_train_test_split(feats, labels, seed)
+    x_tr = np.concatenate([f.reshape(len(f), -1) for f in f_tr], axis=1)
+    x_te = np.concatenate([f.reshape(len(f), -1) for f in f_te], axis=1)
+    args.input_dim = int(x_tr.shape[1])
 
     idx_map = homo_partition(len(y_tr), client_num, seed)
     te_map = homo_partition(len(y_te), client_num, seed + 1)

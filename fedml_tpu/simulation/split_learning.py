@@ -557,32 +557,29 @@ class VFLAPI:
         return load_vfl_party_csvs(d)
 
     def _pack_party_data(self, feats, labels, batch_size: int):
-        """Row-aligned party arrays -> two (xs, y, mask) batch sets
-        (80/20 train/test split on the shared row axis)."""
-        n = len(labels)
-        # seeded row shuffle first: published party extracts are often
-        # label-sorted, which would make an ordered 80/20 split
-        # degenerate (single-class test set)
-        perm = np.random.RandomState(
-            int(getattr(self.args, "random_seed", 0))
-        ).permutation(n)
-        feats = [f[perm] for f in feats]
-        labels = labels[perm]
-        n_tr = max(1, int(0.8 * n))
+        """Row-aligned party arrays -> two (xs, y, mask) batch sets.
+        The train/test split comes from the CANONICAL shared helper
+        (ingest.vfl_train_test_split) — the loader's horizontal view of
+        the same CSVs uses it too, so the two views can never leak test
+        rows into each other's training split."""
+        from ..data.ingest import vfl_train_test_split
 
-        def pack(lo, hi):
-            m = hi - lo
+        f_tr, y_tr, f_te, y_te = vfl_train_test_split(
+            feats, labels, int(getattr(self.args, "random_seed", 0))
+        )
+
+        def pack(split_feats, split_labels):
+            m = len(split_labels)
             nb = max(1, -(-m // batch_size))
             pad = nb * batch_size - m
             xs = []
-            for f in feats:
-                sl = f[lo:hi]
+            for sl in split_feats:
                 if pad:
                     sl = np.concatenate(
                         [sl, np.zeros((pad,) + sl.shape[1:], sl.dtype)]
                     )
                 xs.append(jnp.asarray(sl.reshape(nb, batch_size, -1)))
-            y = labels[lo:hi]
+            y = split_labels
             if pad:
                 y = np.concatenate([y, np.zeros(pad, y.dtype)])
             mask = np.concatenate(
@@ -594,7 +591,7 @@ class VFLAPI:
                 jnp.asarray(mask.reshape(nb, batch_size)),
             )
 
-        return pack(0, n_tr), pack(n_tr, n)
+        return pack(f_tr, y_tr), pack(f_te, y_te)
 
     def _split_batches(self, b: Batches):
         """[nb, bs, ...] -> (party feature slices [nb, bs, d_k], y, mask)."""

@@ -81,6 +81,64 @@ def _run_world(args_factory, run_id, slow_rank=None, delay_s=0.0, **kw):
     return server, wall, threads
 
 
+class TestEvalOverlap:
+    def test_server_eval_overlaps_client_training(self, args_factory):
+        """The server broadcasts the next round BEFORE evaluating the
+        closed one, so clients train under the server's eval (the
+        reference stalls every client for it). With eval=1.0s and
+        train=0.8s per round, overlapped rounds cost ~max(1.0, 0.8),
+        serialized rounds would cost ~1.8s."""
+        def make(rank):
+            a = _mk(args_factory, "overlap1", comm_round=3)
+            a.rank = rank
+            a = fedml_tpu.init(a)
+            ds = load(a)
+            m = models.create(a, ds.class_num)
+            return a, ds, m
+
+        a0, ds0, m0 = make(0)
+        server = Server(a0, None, ds0, m0)
+
+        eval_windows = {}  # round -> (start, end)
+
+        def slow_eval(round_idx):
+            t0 = time.perf_counter()
+            time.sleep(1.0)
+            eval_windows[round_idx] = (t0, time.perf_counter())
+
+        server.aggregator.test_on_server_for_all_clients = slow_eval
+        train_starts = {}  # round -> first client train start
+
+        clients = []
+        for r in range(1, 4):
+            a, ds, m = make(r)
+            c = Client(a, None, ds, m)
+            orig = c.trainer.train
+
+            def timed(params, round_idx, _o=orig):
+                train_starts.setdefault(round_idx, time.perf_counter())
+                time.sleep(0.8)
+                return _o(params, round_idx)
+
+            c.trainer.train = timed
+            clients.append(c)
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert server.manager.round_idx == 3
+        # ordering proof: clients began training round r+1 BEFORE the
+        # server finished evaluating round r (for both overlapped rounds)
+        for r in (0, 1):
+            eval_start, eval_end = eval_windows[r]
+            assert train_starts[r + 1] < eval_end, (
+                f"round {r + 1} training started after round {r} eval "
+                "ended — no overlap"
+            )
+
+
 class TestDeadlineCohort:
     def test_straggler_dropped_rounds_complete(self, args_factory):
         # deadline must cover worst-case jit compile for the two fast
