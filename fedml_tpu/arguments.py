@@ -69,6 +69,12 @@ _DEFAULTS: Dict[str, Any] = {
     # whoever reported within this many seconds of the round broadcast,
     # reweighted over the subset. 0 = wait for everyone (reference).
     "aggregation_deadline_s": 0.0,
+    # uplink compression (cross-silo; beyond the reference): clients
+    # ship encoded update deltas instead of full fp32 params.
+    # "none" | "int8" (4x, lossless-ish) | "topk" (ratio-controlled
+    # sparsification with error feedback, core/compression.py)
+    "compression": "none",
+    "compression_topk_ratio": 0.01,
     # elastic membership (cross-silo; beyond the reference): start once
     # client_num_per_round clients are online, accept mid-run joins,
     # survive OFFLINE leaves. False = fixed membership (reference).
@@ -187,7 +193,13 @@ class Arguments:
             "random_seed",
         ):
             setattr(self, int_key, int(getattr(self, int_key)))
-        for float_key in ("learning_rate", "server_lr", "partition_alpha", "fedprox_mu"):
+        for float_key in (
+            "learning_rate",
+            "server_lr",
+            "partition_alpha",
+            "fedprox_mu",
+            "compression_topk_ratio",
+        ):
             setattr(self, float_key, float(getattr(self, float_key)))
 
     # -- niceties ------------------------------------------------------

@@ -61,6 +61,9 @@ MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
 MSG_ARG_KEY_CLIENT_STATUS = "client_status"
 MSG_ARG_KEY_ROUND_INDEX = "round_idx"
 MSG_ARG_KEY_MODEL_FILE_URL = "model_file_url"
+# compressed-uplink protocol (core/compression.py — beyond the
+# reference): encoded update delta instead of full model_params
+MSG_ARG_KEY_MODEL_DELTA = "model_delta"
 
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_STATUS_IDLE = "IDLE"

@@ -132,7 +132,10 @@ class HybridCommunicationManager(BaseCommunicationManager, Observer):
         self,
         control: BaseCommunicationManager,
         store: PayloadStore,
-        payload_keys=(constants.MSG_ARG_KEY_MODEL_PARAMS,),
+        payload_keys=(
+            constants.MSG_ARG_KEY_MODEL_PARAMS,
+            constants.MSG_ARG_KEY_MODEL_DELTA,
+        ),
     ) -> None:
         self.control = control
         self.store = store

@@ -1,0 +1,167 @@
+"""Uplink model-update compression for federated exchange.
+
+Beyond the reference: Cossack9989/FedML ships no gradient/update
+compression — every client→server upload is the full fp32 state_dict
+(``cross_silo/horizontal/fedml_client_manager.py`` sends
+``model_params`` whole). This module adds the two standard FL codecs on
+top of the delta-exchange protocol, designed TPU-side:
+
+- ``int8``: per-leaf symmetric linear quantization (scale = max|x|/127).
+  ~4x wire reduction, negligible accuracy cost; encode/decode are pure
+  jnp and run on device, so only int8 buffers ever reach the host.
+- ``topk``: magnitude top-k over the flattened update with client-side
+  error feedback (Stich et al., "Sparsified SGD with Memory",
+  arXiv:1809.07599): the residual the codec drops this round is carried
+  into the next round's update, which is what makes aggressive
+  sparsification (1-10%) converge. Indices ship as int32, values fp32.
+
+Protocol (cross-silo horizontal): instead of the trained params, the
+client ships ``encode(trained - received_global + residual)`` under
+``MSG_ARG_KEY_MODEL_DELTA``; the server reconstructs
+``received_global + decode(payload)`` and feeds the usual weighted
+aggregation, so robust aggregation / the L3 server seam compose
+unchanged. The server's pre-round ``global_params`` is exactly the tree
+every cohort client started from, so no extra bookkeeping is needed.
+
+Codecs are stateless; error-feedback state (the residual tree) lives in
+``EncoderState`` owned by the client manager.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+COMPRESSION_NONE = "none"
+COMPRESSION_INT8 = "int8"
+COMPRESSION_TOPK = "topk"
+
+
+def _leaf_encode_int8(x: jax.Array) -> Dict[str, jax.Array]:
+    scale = jnp.max(jnp.abs(x)) / 127.0
+    # all-zero leaf -> scale 0; guard the divide, decode yields zeros
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def _leaf_decode_int8(enc: Dict[str, jax.Array]) -> jax.Array:
+    return enc["q"].astype(jnp.float32) * enc["scale"]
+
+
+class Int8Codec:
+    """Per-leaf symmetric int8 quantization. Deterministic, jitted."""
+
+    name = COMPRESSION_INT8
+
+    @staticmethod
+    @jax.jit
+    def encode(delta: Params) -> Params:
+        return jax.tree.map(_leaf_encode_int8, delta)
+
+    @staticmethod
+    @jax.jit
+    def decode(encoded: Params) -> Params:
+        return jax.tree.map(
+            _leaf_decode_int8, encoded, is_leaf=lambda n: isinstance(n, dict) and "q" in n
+        )
+
+
+class TopKCodec:
+    """Global magnitude top-k over the flattened update tree.
+
+    ``ratio`` is the kept fraction (0.01 = keep 1% of coordinates). The
+    selection is global across leaves (not per-leaf) so tiny bias
+    vectors don't consume budget that large kernels need — one
+    ``jax.lax.top_k`` over the concatenated |update|.
+    """
+
+    name = COMPRESSION_TOPK
+
+    def __init__(self, ratio: float) -> None:
+        if not 0.0 < ratio <= 1.0:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        self.ratio = float(ratio)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def encode(self, delta: Params) -> Dict[str, jax.Array]:
+        leaves = jax.tree.leaves(delta)
+        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        k = max(1, int(round(flat.size * self.ratio)))
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        return {"idx": idx.astype(jnp.int32), "val": flat[idx], "size": flat.size}
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def decode(self, encoded: Dict[str, jax.Array], like: Params) -> Params:
+        """Scatter the kept coordinates back into a tree shaped like
+        ``like`` (the receiver always has the global tree for shapes)."""
+        leaves, treedef = jax.tree.flatten(like)
+        flat = jnp.zeros(sum(l.size for l in leaves), dtype=jnp.float32)
+        flat = flat.at[encoded["idx"]].set(encoded["val"])
+        out, off = [], 0
+        for l in leaves:
+            out.append(flat[off : off + l.size].reshape(l.shape))
+            off += l.size
+        return jax.tree.unflatten(treedef, out)
+
+
+class EncoderState:
+    """Client-side error feedback: the residual dropped by the codec is
+    added into the next round's update before encoding."""
+
+    def __init__(self, codec) -> None:
+        self.codec = codec
+        self.residual: Optional[Params] = None
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_topk(self, delta: Params, residual: Params):
+        corrected = jax.tree.map(jnp.add, delta, residual)
+        enc = self.codec.encode(corrected)
+        sent = self.codec.decode(enc, corrected)
+        new_residual = jax.tree.map(jnp.subtract, corrected, sent)
+        return enc, new_residual
+
+    def encode(self, delta: Params) -> Params:
+        if isinstance(self.codec, Int8Codec):
+            # int8 rounding error is ~scale/2 per coordinate; error
+            # feedback adds nothing measurable, skip the extra state
+            return self.codec.encode(delta)
+        if self.residual is None:
+            self.residual = jax.tree.map(jnp.zeros_like, delta)
+        enc, self.residual = self._step_topk(delta, self.residual)
+        return enc
+
+
+def make_codec(args):
+    """``args.compression`` -> codec instance (or None)."""
+    kind = str(getattr(args, "compression", COMPRESSION_NONE) or COMPRESSION_NONE)
+    if kind == COMPRESSION_NONE:
+        return None
+    if kind == COMPRESSION_INT8:
+        return Int8Codec()
+    if kind == COMPRESSION_TOPK:
+        return TopKCodec(float(getattr(args, "compression_topk_ratio", 0.01)))
+    raise ValueError(f"unknown compression '{kind}'")
+
+
+def decode_delta(codec, encoded: Params, like: Params) -> Params:
+    """Server-side decode; dispatches on codec kind."""
+    if isinstance(codec, TopKCodec):
+        return codec.decode(encoded, like)
+    return codec.decode(encoded)
+
+
+def encoded_nbytes(encoded: Params) -> int:
+    """Wire size of an encoded payload (sum of leaf buffer bytes)."""
+    return int(
+        sum(
+            np.asarray(l).nbytes
+            for l in jax.tree.leaves(encoded)
+        )
+    )

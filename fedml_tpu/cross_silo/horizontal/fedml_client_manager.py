@@ -76,6 +76,10 @@ class FedMLClientManager(ClientManager):
         super().__init__(args, comm, rank, size, backend)
         self.trainer = trainer
         self.server_rank = 0
+        from ...core.compression import EncoderState, make_codec
+
+        codec = make_codec(args)
+        self._encoder = EncoderState(codec) if codec is not None else None
         from ...core.tracking import ProfilerEvent
 
         # spans mirror the reference's instrumentation points
@@ -141,7 +145,16 @@ class FedMLClientManager(ClientManager):
         out = Message(
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, self.server_rank
         )
-        out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)
+        if self._encoder is not None:
+            # compressed uplink (core/compression.py): ship the encoded
+            # update delta; the server reconstructs against the same
+            # global tree it broadcast this round
+            delta = jax.tree.map(lambda a, b: a - b, new_params, params)
+            out.add_params(
+                constants.MSG_ARG_KEY_MODEL_DELTA, self._encoder.encode(delta)
+            )
+        else:
+            out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         out.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, n)
         # round tag: lets a deadline-cohort server discard stale uploads
         out.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)

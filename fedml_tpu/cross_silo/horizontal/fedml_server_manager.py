@@ -106,6 +106,11 @@ class FedMLServerManager(ServerManager):
             )
         self.joins = 0
         self.leaves = 0
+        from ...core.compression import make_codec
+
+        # compressed-uplink decode (core/compression.py): clients ship
+        # encoded deltas; reconstruct against the pre-round global tree
+        self._codec = make_codec(args)
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -312,6 +317,38 @@ class FedMLServerManager(ServerManager):
             )
             return
         model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+        if model_params is None:
+            encoded = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
+            if encoded is None or self._codec is None:
+                # config mismatch is fatal but must not strand clients:
+                # shut the federation down cleanly (same pattern as the
+                # no-online-clients path in _broadcast_model)
+                logging.error(
+                    "rank %d upload %s; configure args.compression "
+                    "identically on server and clients — finishing run",
+                    sender_rank,
+                    "carries neither model_params nor model_delta"
+                    if encoded is None
+                    else "is compressed but server has compression=none",
+                )
+                self.send_finish()
+                self.finish()
+                return
+            import jax
+
+            from ...core.compression import decode_delta
+
+            g = self.aggregator.get_global_model_params()
+            delta = decode_delta(self._codec, encoded, g)
+            model_params = jax.tree.map(lambda a, b: a + b, g, delta)
+        elif self._codec is not None:
+            logging.warning(
+                "server has compression=%s but rank %d uploaded full "
+                "model_params; aggregating it, but the uplink is NOT "
+                "compressed — check the client config",
+                self.args.compression,
+                sender_rank,
+            )
         local_sample_num = msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
             sender_rank - 1, model_params, local_sample_num
