@@ -95,7 +95,7 @@ class TopKCodec:
         flat = jnp.concatenate([l.reshape(-1) for l in leaves])
         k = max(1, int(round(flat.size * self.ratio)))
         _, idx = jax.lax.top_k(jnp.abs(flat), k)
-        return {"idx": idx.astype(jnp.int32), "val": flat[idx], "size": flat.size}
+        return {"idx": idx.astype(jnp.int32), "val": flat[idx]}
 
     @functools.partial(jax.jit, static_argnums=0)
     def decode(self, encoded: Dict[str, jax.Array], like: Params) -> Params:
@@ -148,6 +148,21 @@ def make_codec(args):
     if kind == COMPRESSION_TOPK:
         return TopKCodec(float(getattr(args, "compression_topk_ratio", 0.01)))
     raise ValueError(f"unknown compression '{kind}'")
+
+
+def payload_matches_codec(codec, encoded: Params) -> bool:
+    """Does this wire payload look like it was produced by ``codec``?
+    Lets a receiver detect int8-vs-topk config skew BEFORE decode
+    (decoding a mismatched payload raises deep inside jit)."""
+    is_topk = (
+        isinstance(encoded, dict)
+        and set(encoded.keys()) == {"idx", "val"}
+    )
+    if isinstance(codec, TopKCodec):
+        return is_topk
+    if isinstance(codec, Int8Codec):
+        return not is_topk
+    return False
 
 
 def decode_delta(codec, encoded: Params, like: Params) -> Params:

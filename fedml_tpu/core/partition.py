@@ -31,11 +31,19 @@ def partition_class_samples_with_dirichlet_distribution(
     Dirichlet(alpha) proportions, zero out clients already holding >= N/n
     samples (balance guard), split the class's shuffled indices."""
     rng.shuffle(idx_k)
-    proportions = rng.dirichlet(np.repeat(alpha, client_num))
+    raw = rng.dirichlet(np.repeat(alpha, client_num))
     proportions = np.array(
-        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)]
+        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(raw, idx_batch)]
     )
-    proportions = proportions / proportions.sum()
+    total = proportions.sum()
+    if total <= 0:
+        # every client is at the N/n balance cap (small-N corner): the
+        # guarded proportions are all zero and the reference's formula
+        # would divide 0/0 and cast NaN to int. Fall back to the
+        # unguarded Dirichlet draw so the split stays well-defined.
+        proportions = raw
+    else:
+        proportions = proportions / total
     proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
     idx_batch = [
         idx_j + idx.tolist()
@@ -58,9 +66,24 @@ def non_iid_partition_with_dirichlet_distribution(
     >= 10 samples (noniid_partition.py:41-43)."""
     net_dataidx_map: Dict[int, np.ndarray] = {}
     rng = np.random.RandomState(seed)
-    min_size = 0
-    N = len(label_list)
-    while min_size < 10:
+    if task == "segmentation":
+        # multi-label: label_list is [classes, ...] of per-class sample
+        # index arrays, so len(label_list) is the CLASS count. Size the
+        # balance guard / retry target on total assignments instead.
+        N = int(sum(len(np.asarray(k)) for k in label_list))
+    else:
+        N = len(label_list)
+    # The reference retries unboundedly until min 10 samples/client
+    # (noniid_partition.py:41-43) — which LIVELOCKS when the config makes
+    # that nearly/actually infeasible (e.g. 50 clients x 600 samples at
+    # alpha=0.1). Bound the retries, keep the best draw, and if the
+    # target is still unmet rebalance deterministically from the
+    # largest clients to the starved ones.
+    target = min(10, N // client_num) if client_num else 0
+    best: List[List[int]] = []
+    best_min = -1
+    max_retries = 100
+    for attempt in range(max_retries):
         idx_batch: List[List[int]] = [[] for _ in range(client_num)]
         if task == "segmentation":
             # multi-label: label_list is [classes, ...] of index arrays
@@ -75,6 +98,25 @@ def non_iid_partition_with_dirichlet_distribution(
                 idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
                     N, alpha, client_num, idx_batch, idx_k, rng
                 )
+        if min_size > best_min:
+            best, best_min = idx_batch, min_size
+        if min_size >= target:
+            break
+    else:
+        logging.warning(
+            "LDA partition: min client size %d < %d after %d draws "
+            "(N=%d, clients=%d, alpha=%s); rebalancing from the largest "
+            "clients",
+            best_min, target, max_retries, N, client_num, alpha,
+        )
+        idx_batch = best
+        sizes = [len(b) for b in idx_batch]
+        while min(sizes) < target:
+            src = int(np.argmax(sizes))
+            dst = int(np.argmin(sizes))
+            idx_batch[dst].append(idx_batch[src].pop())
+            sizes[src] -= 1
+            sizes[dst] += 1
     for i in range(client_num):
         rng.shuffle(idx_batch[i])
         net_dataidx_map[i] = np.array(idx_batch[i], dtype=np.int64)

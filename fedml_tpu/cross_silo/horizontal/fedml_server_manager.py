@@ -319,7 +319,20 @@ class FedMLServerManager(ServerManager):
         model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         if model_params is None:
             encoded = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
-            if encoded is None or self._codec is None:
+            from ...core.compression import decode_delta, payload_matches_codec
+
+            if encoded is None:
+                mismatch = "carries neither model_params nor model_delta"
+            elif self._codec is None:
+                mismatch = "is compressed but server has compression=none"
+            elif not payload_matches_codec(self._codec, encoded):
+                mismatch = (
+                    f"payload does not match server codec "
+                    f"'{self._codec.name}' (int8 vs topk skew)"
+                )
+            else:
+                mismatch = None
+            if mismatch:
                 # config mismatch is fatal but must not strand clients:
                 # shut the federation down cleanly (same pattern as the
                 # no-online-clients path in _broadcast_model)
@@ -327,16 +340,12 @@ class FedMLServerManager(ServerManager):
                     "rank %d upload %s; configure args.compression "
                     "identically on server and clients — finishing run",
                     sender_rank,
-                    "carries neither model_params nor model_delta"
-                    if encoded is None
-                    else "is compressed but server has compression=none",
+                    mismatch,
                 )
                 self.send_finish()
                 self.finish()
                 return
             import jax
-
-            from ...core.compression import decode_delta
 
             g = self.aggregator.get_global_model_params()
             delta = decode_delta(self._codec, encoded, g)

@@ -146,8 +146,8 @@ def make_sequence_sharded_attention(
     """Wrap a strategy as a [B, T, H, D] -> [B, T, H, D] function whose
     sequence axis is sharded over ``mesh[axis_name]`` via shard_map —
     drop-in for dense attention inside a pjit'ed training step."""
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
     inner = functools.partial(fn, axis_name=axis_name, causal=causal)
@@ -158,5 +158,5 @@ def make_sequence_sharded_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )

@@ -163,10 +163,17 @@ class TestCompressedFederation:
         stats = server.aggregator.test_on_server_for_all_clients(99)
         assert stats["loss"] < np.log(10) * 0.5  # well below chance
 
-    def test_codec_mismatch_shuts_down_cleanly(self, args_factory):
-        """Server compression=none + client compression=topk is a fatal
-        misconfiguration — the server must FINISH the federation (not
-        strand clients on their inboxes, not aggregate garbage)."""
+    @pytest.mark.parametrize(
+        "server_comp,client_comp",
+        [("none", "topk"), ("int8", "topk"), ("topk", "int8")],
+    )
+    def test_codec_mismatch_shuts_down_cleanly(
+        self, args_factory, server_comp, client_comp
+    ):
+        """Compression config skew (none-vs-compressed or int8-vs-topk)
+        is a fatal misconfiguration — the server must FINISH the
+        federation (not strand clients on their inboxes, not crash the
+        receive loop, not aggregate garbage)."""
         import threading
 
         import fedml_tpu
@@ -176,17 +183,19 @@ class TestCompressedFederation:
         from test_cross_silo import _mk_args
 
         def make(rank, **kw):
-            a = _mk_args(args_factory, "comp_mismatch", "LOCAL", **kw)
+            a = _mk_args(
+                args_factory, f"comp_mm_{server_comp}_{client_comp}", "LOCAL", **kw
+            )
             a.rank = rank
             a = fedml_tpu.init(a)
             ds = load(a)
             return a, ds, models.create(a, ds.class_num)
 
-        a0, ds0, m0 = make(0)  # server: compression none
+        a0, ds0, m0 = make(0, compression=server_comp)
         server = Server(a0, None, ds0, m0)
         clients = []
         for r in range(1, 5):
-            a, ds, m = make(r, compression="topk")
+            a, ds, m = make(r, compression=client_comp)
             clients.append(Client(a, None, ds, m))
         threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
         for t in threads:

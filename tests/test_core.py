@@ -48,6 +48,22 @@ class TestPartition:
 
         assert skew(0.1) > skew(100.0)
 
+    def test_lda_infeasible_config_terminates(self):
+        """Regression: the reference's unbounded min-10 retry livelocks
+        when the target is (nearly) infeasible — 50 clients over 600
+        samples at alpha=0.1. Bounded retries + rebalancing must return
+        a full cover with the feasible minimum, fast."""
+        y = np.random.RandomState(0).randint(0, 10, 600)
+        m = non_iid_partition_with_dirichlet_distribution(y, 50, 10, 0.1, seed=0)
+        sizes = [len(m[i]) for i in range(50)]
+        assert sum(sizes) == 600  # still a partition
+        assert min(sizes) >= 10  # 600 // 50 >= 10 -> target holds
+        # more clients than samples: min target degrades gracefully
+        m2 = non_iid_partition_with_dirichlet_distribution(
+            np.random.RandomState(1).randint(0, 5, 30), 40, 5, 0.5, seed=0
+        )
+        assert sum(len(v) for v in m2.values()) == 30
+
     def test_homo_equal_shards(self):
         m = homo_partition(100, 4, seed=0)
         assert all(len(m[i]) == 25 for i in range(4))
