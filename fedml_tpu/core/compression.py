@@ -154,10 +154,9 @@ def payload_matches_codec(codec, encoded: Params) -> bool:
     """Does this wire payload look like it was produced by ``codec``?
     Lets a receiver detect int8-vs-topk config skew BEFORE decode
     (decoding a mismatched payload raises deep inside jit)."""
-    is_topk = (
-        isinstance(encoded, dict)
-        and set(encoded.keys()) == {"idx", "val"}
-    )
+    # subset (not exact-set) so an older peer shipping extra metadata
+    # keys alongside idx/val still decodes rather than killing the run
+    is_topk = isinstance(encoded, dict) and {"idx", "val"} <= set(encoded.keys())
     if isinstance(codec, TopKCodec):
         return is_topk
     if isinstance(codec, Int8Codec):

@@ -66,6 +66,10 @@ def non_iid_partition_with_dirichlet_distribution(
     >= 10 samples (noniid_partition.py:41-43)."""
     net_dataidx_map: Dict[int, np.ndarray] = {}
     rng = np.random.RandomState(seed)
+    if classes == 0 or len(label_list) == 0:
+        # degenerate: nothing to allocate; every client gets an empty
+        # shard (previously this livelocked / raised downstream)
+        return {i: np.array([], dtype=np.int64) for i in range(client_num)}
     if task == "segmentation":
         # multi-label: label_list is [classes, ...] of per-class sample
         # index arrays, so len(label_list) is the CLASS count. Size the

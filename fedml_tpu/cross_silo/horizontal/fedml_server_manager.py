@@ -111,6 +111,38 @@ class FedMLServerManager(ServerManager):
         # compressed-uplink decode (core/compression.py): clients ship
         # encoded deltas; reconstruct against the pre-round global tree
         self._codec = make_codec(args)
+        # checkpoint/resume (core/checkpoint.py — beyond the reference,
+        # which loses the whole federation when the server dies): save
+        # {params, round} after aggregation; on construction, restore
+        # the latest state so a restarted server resumes mid-federation.
+        # Clients are stateless between rounds (they receive the model
+        # with every broadcast), so server-side state is sufficient.
+        self._ckpt = None
+        ckpt_dir = getattr(args, "checkpoint_dir", None)
+        if ckpt_dir:
+            from ...core.checkpoint import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer(ckpt_dir)
+            self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
+            state = self._ckpt.restore()
+            if state is not None:
+                import jax
+
+                self.round_idx = int(state["round_idx"])
+                self.aggregator.set_global_model_params(
+                    jax.device_put(state["params"], jax.devices()[0])
+                )
+                # the aggregation counter seeds the L3 server
+                # aggregator's per-round rng stream — without it a
+                # resumed custom aggregator would silently replay
+                # round 0's randomness
+                self.aggregator._agg_round = int(
+                    state.get("agg_round", self.round_idx)
+                )
+                logging.info(
+                    "cross-silo server resumed at round %d from %s",
+                    self.round_idx, ckpt_dir,
+                )
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -194,6 +226,20 @@ class FedMLServerManager(ServerManager):
 
     def send_init_msg(self) -> None:
         """(fedml_server_manager.py:47-69)"""
+        if self.round_idx >= self.round_num:
+            # resumed from a checkpoint taken at/after the final round:
+            # nothing left to train, release the freshly-connected
+            # clients instead of broadcasting a round past the end. The
+            # pre-crash process may have died between its final save
+            # and its final eval, so produce the terminal eval here.
+            logging.info(
+                "resumed at round %d >= comm_round %d; finishing",
+                self.round_idx, self.round_num,
+            )
+            self.aggregator.test_on_server_for_all_clients(self.round_num - 1)
+            self.send_finish()
+            self.finish()
+            return
         self._broadcast_model(constants.MSG_TYPE_S2C_INIT_CONFIG)
 
     def _broadcast_model(self, msg_type: str) -> None:
@@ -390,7 +436,17 @@ class FedMLServerManager(ServerManager):
         eval_round = self.round_idx
         cohort = self.aggregator.client_num  # before begin_round re-arms
         self.round_idx += 1
+        ckpt_due = (
+            self._ckpt is not None
+            and n_aggregated
+            and (
+                self.round_idx % self._ckpt_freq == 0
+                or self.round_idx >= self.round_num
+            )
+        )
         if self.round_idx >= self.round_num:
+            if ckpt_due:
+                self._save_checkpoint()
             if n_aggregated:
                 self.aggregator.test_on_server_for_all_clients(eval_round)
             self._report_round(eval_round, cohort, n_aggregated)
@@ -401,12 +457,28 @@ class FedMLServerManager(ServerManager):
         # overlap comm and compute explicitly"; the reference evals
         # before syncing, stalling every client for the server's eval):
         # broadcast the next round FIRST so clients train while the
-        # server evaluates the round that just closed.
+        # server evaluates the round that just closed. The checkpoint
+        # save rides the same overlap window — it reads only state the
+        # broadcast does not mutate.
         self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+        if ckpt_due:
+            self._save_checkpoint()
         if n_aggregated:
             with self.profiler.span("server_eval_overlapped"):
                 self.aggregator.test_on_server_for_all_clients(eval_round)
         self._report_round(eval_round, cohort, n_aggregated)
+
+    def _save_checkpoint(self) -> None:
+        """step = the NEXT round to run; a restarted server picks up
+        exactly where the broadcast would have gone."""
+        self._ckpt.save(
+            self.round_idx,
+            {
+                "params": self.aggregator.get_global_model_params(),
+                "round_idx": self.round_idx,
+                "agg_round": self.aggregator._agg_round,
+            },
+        )
 
     def _report_round(self, round_idx: int, cohort: int, n_aggregated: int) -> None:
         self.metrics_reporter.report(

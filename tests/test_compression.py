@@ -101,6 +101,19 @@ class TestCodecs:
                 np.asarray(a - b), np.asarray(res), atol=1e-4
             )
 
+    def test_payload_codec_match(self):
+        from fedml_tpu.core.compression import payload_matches_codec
+
+        t = _tree()
+        tk = TopKCodec(0.1)
+        enc_tk, enc_q8 = tk.encode(t), Int8Codec.encode(t)
+        assert payload_matches_codec(tk, enc_tk)
+        assert payload_matches_codec(Int8Codec(), enc_q8)
+        assert not payload_matches_codec(tk, enc_q8)
+        assert not payload_matches_codec(Int8Codec(), enc_tk)
+        # forward-compat: extra metadata keys must not read as skew
+        assert payload_matches_codec(tk, dict(enc_tk, size=2400))
+
     def test_make_codec_dispatch(self, args_factory):
         assert make_codec(args_factory(compression="none")) is None
         assert isinstance(make_codec(args_factory(compression="int8")), Int8Codec)

@@ -63,6 +63,11 @@ class TestPartition:
             np.random.RandomState(1).randint(0, 5, 30), 40, 5, 0.5, seed=0
         )
         assert sum(len(v) for v in m2.values()) == 30
+        # zero classes / empty labels: empty shards, no livelock/raise
+        m3 = non_iid_partition_with_dirichlet_distribution(
+            np.array([], dtype=np.int64), 3, 0, 0.5, seed=0
+        )
+        assert all(len(v) == 0 for v in m3.values())
 
     def test_homo_equal_shards(self):
         m = homo_partition(100, 4, seed=0)
