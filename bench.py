@@ -19,7 +19,9 @@ same jitted per-client step so the comparison isolates architecture).
   dispatch-bound); ``per_client_efficiency`` is the strong-scaling view
   (per-client throughput vs the 8-client cohort — bounded by 8/C once
   one chip saturates; >8/C headroom requires more chips, which is what
-  the mesh simulator's ``clients`` axis provides);
+  the mesh simulator's ``clients`` axis provides). If the 8-client
+  cohort itself was skipped, the smallest completed cohort becomes the
+  base and ``retention_base_clients`` records it;
 - ``samples_per_sec_per_chip`` and an MFU figure: XLA's own cost
   analysis of the round computation (compiled.cost_analysis()['flops'])
   over wall time, against the chip's peak (device-kind table);
@@ -28,15 +30,21 @@ same jitted per-client step so the comparison isolates architecture).
   (msgpack serialize + deserialize + device_put, what every reference
   exchange does) round-trip time for the model tree.
 
-Robustness contract (VERDICT round 1): TPU init is probed in a
-subprocess with a timeout; on failure we retry then fall back to a
-scaled-down CPU run. A JSON line is emitted on every exit path.
+Robustness contract (VERDICT round 1, hardened round 3): TPU init is
+probed in a subprocess with a timeout; on failure we retry then fall
+back to a scaled-down CPU run. Every TPU phase additionally runs in
+its OWN subprocess with its own timeout — observed failure mode: a
+large sweep cohort can wedge the TPU tunnel mid-run, which would
+otherwise hang the whole bench past the driver's window. A wedged
+phase is recorded as skipped (with reason) and the parent still emits
+the single JSON line from whatever completed.
 """
 
 import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 # Probe budget sizing: a stalled TPU tunnel must leave enough of the
@@ -80,7 +88,7 @@ def _probe_tpu() -> tuple[bool, str]:
         "x.block_until_ready();"
         "print('PROBE_OK', d[0].platform)"
     )
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env = _child_env()
     last = ""
     for attempt in range(PROBE_ATTEMPTS):
         if attempt:
@@ -262,7 +270,10 @@ def _aggregation_exchange(model, n_iter: int = 20) -> dict:
     }
 
 
-def run_bench(on_cpu: bool) -> dict:
+def run_headline(on_cpu: bool) -> dict:
+    """Headline rounds/s + sequential baseline + MFU + exchange metric
+    (everything except the scaling sweep, which runs in isolated
+    per-cohort subprocesses — see main())."""
     import jax
 
     _progress(f"backend up: {jax.devices()[0]}")
@@ -272,13 +283,6 @@ def run_bench(on_cpu: bool) -> dict:
     epochs = 1 if on_cpu else 5
     n_rounds = 3 if on_cpu else 10
     n_seq = 1 if on_cpu else 2
-    # the scaling sweep is a TPU metric; the CPU emergency fallback
-    # keeps only the headline (on 6x less data per client) so even a
-    # worst-case stalled-probe start (~120s) finishes inside the
-    # driver's ~580s window (measured ~290s end to end). Three sweep
-    # cohorts keep the TPU path under it too.
-    sweep_cohorts = [] if on_cpu else [8, 32, 256]
-    per_client = 100
     headline_per_client = 100 if on_cpu else 600
 
     args, dataset, model, api = _build_api(
@@ -324,31 +328,6 @@ def run_bench(on_cpu: bool) -> dict:
             detail["mfu_vs_bf16_peak"] = round(achieved / (peak * n_chips), 4)
             detail["peak_assumed_tflops"] = peak / 1e12
 
-    # scaling sweep vs the smallest cohort
-    scaling = []
-    base_sps = None
-    base_clients = None
-    for c in sweep_cohorts:
-        a_c, ds_c, _m_c, api_c = _build_api(c, epochs=1, per_client=per_client)
-        rps_c, spr_c, _ = _time_rounds(api_c, ds_c, a_c, n_rounds=3)
-        _progress(f"sweep cohort {c}: {rps_c:.3f} rounds/s")
-        sps_c = rps_c * spr_c
-        if base_sps is None:
-            base_sps, base_clients = sps_c, c
-        scaling.append(
-            {
-                "clients": c,
-                "rounds_per_sec": round(rps_c, 4),
-                "samples_per_sec": round(sps_c, 1),
-                "throughput_retention_vs_8": round(sps_c / base_sps, 3),
-                "per_client_efficiency": round(
-                    (sps_c / c) / (base_sps / base_clients), 3
-                ),
-            }
-        )
-    if scaling:
-        detail["scaling"] = scaling
-
     detail["aggregation_exchange"] = _aggregation_exchange(model)
 
     return {
@@ -360,32 +339,189 @@ def run_bench(on_cpu: bool) -> dict:
     }
 
 
-def main() -> None:
-    _progress("probing TPU")
-    tpu_ok, note = _probe_tpu()
-    _progress(f"probe: ok={tpu_ok} ({note})")
-    if tpu_ok:
-        os.environ.pop("JAX_PLATFORMS", None)
-    else:
-        _force_cpu()
+def run_sweep_cohort(c: int) -> dict:
+    """One scaling-sweep point (isolated in its own process)."""
+    args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
+    rps, spr, _ = _time_rounds(api, dataset, args, n_rounds=3)
+    _progress(f"sweep cohort {c}: {rps:.3f} rounds/s")
+    return {
+        "clients": c,
+        "rounds_per_sec": round(rps, 4),
+        "samples_per_sec": round(rps * spr, 1),
+    }
+
+
+def _child_env() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    return env
+
+
+def _run_phase_subprocess(phase_args, timeout_s: float):
+    """Run `bench.py --phase ...` in a child; returns (dict|None, note).
+    Isolation is the point: a wedged TPU tunnel kills the child at its
+    timeout, not the whole bench."""
+    with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
+        out_path = f.name
+    cmd = [sys.executable, os.path.abspath(__file__)] + phase_args + ["--out", out_path]
     try:
-        result = run_bench(on_cpu=not tpu_ok)
-        if not tpu_ok:
-            result["error"] = f"TPU unavailable, CPU fallback: {note}"
-        _emit(result)
-    except Exception as e:  # noqa: BLE001 — contract: always emit a JSON line
+        r = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=_child_env(),
+        )
+        for line in (r.stderr or "").splitlines():
+            print(line, file=sys.stderr, flush=True)
+        if r.returncode == 0:
+            with open(out_path) as fh:
+                return json.load(fh), "ok"
+        tail = (r.stderr or r.stdout or "").strip().splitlines()[-1:]
+        return None, f"rc={r.returncode}: {tail[0] if tail else ''}"
+    except subprocess.TimeoutExpired as te:
+        # forward whatever breadcrumbs the child got out before it hung
+        # — the wedged-TPU case is exactly the one needing diagnostics
+        partial = te.stderr or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        for line in partial.splitlines()[-20:]:
+            print(line, file=sys.stderr, flush=True)
+        return None, f"timeout after {timeout_s:.0f}s"
+    except Exception as e:  # noqa: BLE001
+        return None, f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
+# total wall budget: the driver gives bench ~580s. Leave headroom for
+# probe (worst 120s) + interpreter startups.
+_BUDGET_S = 560.0
+_HEADLINE_TIMEOUT_S = 320.0
+_SWEEP_TIMEOUT_S = 90.0
+_SWEEP_COHORTS = [8, 32, 256]
+
+
+def _elapsed() -> float:
+    return time.perf_counter() - _T0
+
+
+def main() -> None:
+    try:
+        _main_guarded()
+    except Exception as e:  # noqa: BLE001 — contract: always emit JSON
         _emit(
             {
                 "metric": "fedavg_rounds_per_sec",
                 "value": 0,
                 "unit": "rounds/s",
                 "vs_baseline": 0,
-                "error": f"{type(e).__name__}: {e}",
-                "tpu_probe": note,
+                "error": f"bench parent crashed: {type(e).__name__}: {e}",
             }
         )
-        sys.exit(0)
+
+
+def _main_guarded() -> None:
+    _progress("probing TPU")
+    tpu_ok, note = _probe_tpu()
+    _progress(f"probe: ok={tpu_ok} ({note})")
+
+    result = None
+    if tpu_ok:
+        result, hnote = _run_phase_subprocess(
+            ["--phase", "headline"], _HEADLINE_TIMEOUT_S
+        )
+        if result is None:
+            _progress(f"TPU headline failed ({hnote}); CPU fallback")
+            note = f"TPU headline: {hnote}"
+            tpu_ok = False
+
+    if result is None:
+        # CPU fallback in a child too (parent never imports jax, so a
+        # wedged backend can never take down the emit path)
+        result, cnote = _run_phase_subprocess(
+            ["--phase", "headline", "--cpu"],
+            max(120.0, _BUDGET_S - _elapsed() - 10),
+        )
+        if result is not None:
+            result["error"] = f"TPU unavailable, CPU fallback: {note}"
+
+    if result is None:
+        _emit(
+            {
+                "metric": "fedavg_rounds_per_sec",
+                "value": 0,
+                "unit": "rounds/s",
+                "vs_baseline": 0,
+                "error": f"all phases failed; probe: {note}; cpu: {cnote}",
+            }
+        )
+        return
+
+    if tpu_ok:
+        # scaling sweep, one isolated child per cohort; 256 last so a
+        # cohort big enough to wedge the tunnel can only cost itself
+        scaling, skipped = [], []
+        for c in _SWEEP_COHORTS:
+            remaining = _BUDGET_S - _elapsed()
+            if remaining < 45:
+                skipped.append({"clients": c, "reason": "budget exhausted"})
+                _progress(f"sweep cohort {c}: skipped (budget)")
+                continue
+            entry, snote = _run_phase_subprocess(
+                ["--phase", "sweep", "--cohort", str(c)],
+                min(_SWEEP_TIMEOUT_S, remaining - 5),
+            )
+            if entry is None:
+                skipped.append({"clients": c, "reason": snote})
+                _progress(f"sweep cohort {c}: skipped ({snote})")
+            else:
+                scaling.append(entry)
+        if scaling:
+            base = min(scaling, key=lambda e: e["clients"])
+            base_sps = max(base["samples_per_sec"], 1e-9)
+            for e in scaling:
+                e["throughput_retention_vs_8"] = round(
+                    e["samples_per_sec"] / base_sps, 3
+                )
+                e["per_client_efficiency"] = round(
+                    (e["samples_per_sec"] / e["clients"])
+                    / (base_sps / base["clients"]),
+                    3,
+                )
+            result["detail"]["scaling"] = scaling
+            result["detail"]["retention_base_clients"] = base["clients"]
+        if skipped:
+            # no silent caps: record what was dropped and why
+            result["detail"]["scaling_skipped"] = skipped
+
+    _emit(result)
+
+
+def _phase_main(argv) -> None:
+    """Child entry: run one phase, write its JSON to --out."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--phase", required=True, choices=["headline", "sweep"])
+    p.add_argument("--cohort", type=int, default=0)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--out", required=True)
+    a = p.parse_args(argv)
+    if a.cpu:
+        _force_cpu()
+    if a.phase == "headline":
+        out = run_headline(on_cpu=a.cpu)
+    else:
+        out = run_sweep_cohort(a.cohort)
+    with open(a.out, "w") as fh:
+        json.dump(out, fh)
 
 
 if __name__ == "__main__":
-    main()
+    if "--phase" in sys.argv:
+        _phase_main(sys.argv[1:])
+    else:
+        main()
