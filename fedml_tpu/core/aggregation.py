@@ -53,6 +53,28 @@ def weighted_average(stacked: Params, weights: jax.Array) -> Params:
     return jax.tree.map(avg, stacked)
 
 
+def is_device_tree(tree: Params) -> bool:
+    """True when the tree has leaves and they are jax device arrays."""
+    leaves = jax.tree.leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.Array)
+
+
+def reconcile_to_device(tree: Params, device=None) -> Params:
+    """``device_put`` only when the tree's device arrays live somewhere
+    other than ``device`` (default: the process's first device). Keeps
+    the in-process zero-copy path zero-copy while letting payloads from
+    a hierarchical silo's private device subset land on the server."""
+    device = device if device is not None else jax.devices()[0]
+    leaves = jax.tree.leaves(tree)
+    if (
+        leaves
+        and isinstance(leaves[0], jax.Array)
+        and leaves[0].sharding.device_set != {device}
+    ):
+        return jax.device_put(tree, device)
+    return tree
+
+
 def pytree_sub(a: Params, b: Params) -> Params:
     return jax.tree.map(jnp.subtract, a, b)
 

@@ -35,7 +35,9 @@ class ClientMasterManager(FedMLClientManager):
         if self.pg.n_proc_in_silo <= 1:
             return
         # networked fabrics serialize; ship host arrays, not jax buffers
-        host_params = jax.tree.map(np.asarray, params) if _is_jax_tree(params) else params
+        from ...core.aggregation import is_device_tree
+
+        host_params = jax.tree.map(np.asarray, params) if is_device_tree(params) else params
         for slave in self.pg.slave_ranks():
             msg = Message(constants.MSG_TYPE_SILO_SYNC_PROCESS_GROUP, 0, slave)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
@@ -64,8 +66,3 @@ class ClientMasterManager(FedMLClientManager):
             self._silo_com.destroy_fabric()
         super().handle_message_finish(msg)
         self.pg.cleanup()
-
-
-def _is_jax_tree(tree) -> bool:
-    leaves = jax.tree.leaves(tree)
-    return bool(leaves) and isinstance(leaves[0], jax.Array)

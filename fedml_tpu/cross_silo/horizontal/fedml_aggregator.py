@@ -66,10 +66,9 @@ class FedMLAggregator:
         zero-copy. Note: FedAvg-family servers aggregate full param
         trees by design; a model-parallel (sharded-params) silo would
         need a sharded server aggregation path instead of this."""
-        server_dev = jax.devices()[0]
-        leaves = jax.tree.leaves(model_params)
-        if leaves and isinstance(leaves[0], jax.Array) and leaves[0].sharding.device_set != {server_dev}:
-            model_params = jax.device_put(model_params, server_dev)
+        from ...core.aggregation import reconcile_to_device
+
+        model_params = reconcile_to_device(model_params)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True

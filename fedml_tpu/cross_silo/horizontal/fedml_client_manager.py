@@ -148,8 +148,20 @@ class FedMLClientManager(ClientManager):
         if self._encoder is not None:
             # compressed uplink (core/compression.py): ship the encoded
             # update delta; the server reconstructs against the same
-            # global tree it broadcast this round
-            delta = jax.tree.map(lambda a, b: a - b, new_params, params)
+            # global tree it broadcast this round. A hierarchical silo
+            # trains on its own device subset (params replicated over
+            # the silo's DP mesh) while the broadcast tree sits on the
+            # server's device — align before subtracting.
+            from ...core.aggregation import is_device_tree
+
+            if is_device_tree(new_params):
+                delta = jax.tree.map(
+                    lambda a, b: a - jax.device_put(b, a.sharding),
+                    new_params,
+                    params,
+                )
+            else:
+                delta = jax.tree.map(lambda a, b: a - b, new_params, params)
             out.add_params(
                 constants.MSG_ARG_KEY_MODEL_DELTA, self._encoder.encode(delta)
             )

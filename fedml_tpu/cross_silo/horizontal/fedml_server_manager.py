@@ -393,7 +393,12 @@ class FedMLServerManager(ServerManager):
                 return
             import jax
 
+            from ...core.aggregation import reconcile_to_device
+
             g = self.aggregator.get_global_model_params()
+            # a hierarchical silo's payload lives on ITS device subset;
+            # reconcile onto the server's device before decoding
+            encoded = reconcile_to_device(encoded)
             delta = decode_delta(self._codec, encoded, g)
             model_params = jax.tree.map(lambda a, b: a + b, g, delta)
         elif self._codec is not None:

@@ -218,3 +218,32 @@ class TestCompressedFederation:
             t.join(timeout=60)
         assert not any(t.is_alive() for t in threads), "clients stranded"
         assert server.manager.round_idx == 0  # no round completed
+
+
+class TestCompressedHierarchical:
+    def test_hierarchical_int8_matches_horizontal_int8(self, args_factory):
+        """The silo master inherits the compressed uplink: hierarchical
+        (2 silos x 2-proc DP) with int8 == horizontal with int8."""
+        from test_hierarchical_cross_silo import (
+            _run_hier_world,
+            _run_horizontal_world,
+        )
+
+        hier = _run_hier_world(
+            args_factory, "comp_hier", compression="int8"
+        )
+        horiz = _run_horizontal_world(
+            args_factory, "comp_horiz", compression="int8"
+        )
+        # atol: the silo DP mesh's reduction order perturbs deltas by
+        # ~1e-6, which can flip a round(x/scale) boundary — a flipped
+        # coordinate differs by one full quantization step (scale =
+        # max|delta|/127). 5e-3 comfortably bounds that step for lr-0.1
+        # MNIST updates (same tolerance as the int8-vs-none oracle).
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3
+            ),
+            hier.aggregator.get_global_model_params(),
+            horiz.aggregator.get_global_model_params(),
+        )
