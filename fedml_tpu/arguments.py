@@ -69,6 +69,10 @@ _DEFAULTS: Dict[str, Any] = {
     # whoever reported within this many seconds of the round broadcast,
     # reweighted over the subset. 0 = wait for everyone (reference).
     "aggregation_deadline_s": 0.0,
+    # on a deadline with ZERO uploads the server rebroadcasts the round
+    # (the downlink may have been lost) at most this many times, then
+    # shuts the federation down instead of extending forever
+    "aggregation_deadline_max_extensions": 3,
     # uplink compression (cross-silo; beyond the reference): clients
     # ship encoded update deltas instead of full fp32 params.
     # "none" | "int8" (4x, lossless-ish) | "topk" (ratio-controlled
@@ -92,6 +96,10 @@ _DEFAULTS: Dict[str, Any] = {
     # tracking
     "enable_tracking": False,
     "run_id": "0",
+    # fault injection (core/comm/faults.py — beyond the reference):
+    # mapping of {drop_prob, duplicate_prob, delay_s, delay_prob, seed,
+    # msg_types, max_faults}; None disables
+    "fault_injection": None,
     # robustness (reference: fedavg_robust example config)
     "defense_type": None,
     "norm_bound": 5.0,

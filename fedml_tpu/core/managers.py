@@ -114,6 +114,11 @@ class _ManagerBase(Observer):
         self.com_manager = comm if comm is not None else _build_com_manager(
             args, rank, size, backend
         )
+        from .comm.faults import maybe_wrap_faulty
+
+        # fault injection (core/comm/faults.py — beyond the reference):
+        # exercised per-process via args.fault_injection
+        self.com_manager = maybe_wrap_faulty(self.com_manager, args)
         self.com_manager.add_observer(self)
         self.message_handler_dict: Dict[int, Callable[[Message], None]] = {}
 

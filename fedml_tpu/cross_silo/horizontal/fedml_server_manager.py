@@ -98,6 +98,13 @@ class FedMLServerManager(ServerManager):
         self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
         self._deadline_timer = None
         self.stragglers_dropped = 0
+        # zero-upload deadline handling: rebroadcast (the downlink may
+        # have been lost) at most this many times per round, then shut
+        # down instead of extending forever
+        _max_ext = getattr(args, "aggregation_deadline_max_extensions", None)
+        self.deadline_max_extensions = 3 if _max_ext is None else int(_max_ext)
+        self._empty_deadline_fires = 0
+        self._last_broadcast_type = None
         self.elastic = bool(getattr(args, "elastic_membership", False))
         if self.elastic and getattr(args, "client_id_list", None):
             raise ValueError(
@@ -275,6 +282,7 @@ class FedMLServerManager(ServerManager):
             self.send_finish()
             self.finish()
             return
+        self._last_broadcast_type = msg_type
         global_params = self.aggregator.get_global_model_params()
         expected = []
         for real_id, silo_idx in zip(selected_real_ids, silo_indexes):
@@ -337,12 +345,32 @@ class FedMLServerManager(ServerManager):
             return  # the round completed in time; stale timer
         n = self.aggregator.num_received()
         if n == 0:
+            # There is nothing to aggregate, so extending alone can
+            # livelock (e.g. a correlated fault ate every uplink, or
+            # the downlink itself was lost and nobody is training).
+            # Rebroadcast the round — _broadcast_model re-runs
+            # selection, resends the model and re-arms the deadline —
+            # a bounded number of times, then shut down loudly.
+            self._empty_deadline_fires += 1
+            if self._empty_deadline_fires > self.deadline_max_extensions:
+                logging.error(
+                    "round %d: %d deadline(s) of %.1fs elapsed with ZERO "
+                    "uploads; giving up (aggregation_deadline_max_extensions=%d)",
+                    self.round_idx, self._empty_deadline_fires - 1,
+                    self.deadline_s, self.deadline_max_extensions,
+                )
+                self.send_finish()
+                self.finish()
+                return
             logging.warning(
-                "round %d deadline (%.1fs) with ZERO uploads; extending",
+                "round %d deadline (%.1fs) with ZERO uploads; rebroadcasting "
+                "(extension %d/%d)",
                 self.round_idx, self.deadline_s,
+                self._empty_deadline_fires, self.deadline_max_extensions,
             )
-            self._arm_deadline()
+            self._broadcast_model(self._last_broadcast_type)
             return
+        self._empty_deadline_fires = 0
         expected = self.aggregator.client_num  # per-round cohort size
         missing = max(expected - n, 0)
         self.stragglers_dropped += missing
@@ -424,6 +452,7 @@ class FedMLServerManager(ServerManager):
         """Aggregate whatever was received, eval, advance (shared by
         the all-received and deadline paths)."""
         self._cancel_deadline()
+        self._empty_deadline_fires = 0
         if self._wait_open:
             self.profiler.log_event_ended("server.wait")
             self._wait_open = False

@@ -1,0 +1,254 @@
+"""Fault injection (core/comm/faults.py) — unit behavior + pairing
+with the failure-handling features it exists to exercise.
+
+Beyond the reference (SURVEY.md §5: "no fault injection"): dropped
+uploads x deadline cohort; duplicated uploads x idempotent
+aggregation; delayed uploads x stale-round discard.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import constants, models
+from fedml_tpu.core.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.core.comm.faults import FaultInjector, maybe_wrap_faulty
+from fedml_tpu.core.message import Message
+from fedml_tpu.data import load
+
+from test_cross_silo import _mk_args, _run_world
+
+
+class _RecordingTransport(BaseCommunicationManager):
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def add_observer(self, o):
+        pass
+
+    def remove_observer(self, o):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+
+@pytest.mark.smoke
+class TestFaultInjectorUnit:
+    def _msg(self, t=3):
+        return Message(t, 1, 0)
+
+    def test_drop_is_deterministic_and_counted(self):
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, drop_prob=0.5, seed=7)
+        for _ in range(100):
+            fi.send_message(self._msg())
+        assert fi.injected["drop"] > 20
+        assert len(rec.sent) + fi.injected["drop"] == 100
+        # same seed -> identical fault pattern
+        rec2 = _RecordingTransport()
+        fi2 = FaultInjector(rec2, drop_prob=0.5, seed=7)
+        for _ in range(100):
+            fi2.send_message(self._msg())
+        assert fi2.injected == fi.injected
+
+    def test_duplicate_sends_twice(self):
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, duplicate_prob=1.0, max_faults=1)
+        fi.send_message(self._msg())
+        fi.send_message(self._msg())  # max_faults reached -> clean send
+        assert fi.injected["duplicate"] == 1
+        assert len(rec.sent) == 3
+
+    def test_msg_type_filter(self):
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, drop_prob=1.0, msg_types=[3])
+        fi.send_message(self._msg(t=5))  # not armed
+        fi.send_message(self._msg(t=3))  # dropped
+        assert len(rec.sent) == 1 and fi.injected["drop"] == 1
+
+    def test_control_signals_exempt_by_default(self):
+        """Loopback timer signals and FINISH have no retry/recovery
+        path; injecting into them models a broken process, not a lossy
+        network — they pass through unless explicitly named."""
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, drop_prob=1.0)
+        fi.send_message(Message(constants.MSG_TYPE_S2S_AGG_DEADLINE, 0, 0))
+        fi.send_message(Message(constants.MSG_TYPE_S2C_FINISH, 0, 1))
+        assert len(rec.sent) == 2 and fi.injected["drop"] == 0
+        # explicit opt-in overrides the FINISH exemption
+        fi2 = FaultInjector(rec, drop_prob=1.0,
+                            msg_types=[constants.MSG_TYPE_S2C_FINISH])
+        fi2.send_message(Message(constants.MSG_TYPE_S2C_FINISH, 0, 1))
+        assert fi2.injected["drop"] == 1
+        # but self-addressed messages are never faulted
+        fi3 = FaultInjector(rec, drop_prob=1.0,
+                            msg_types=[constants.MSG_TYPE_S2S_AGG_DEADLINE])
+        fi3.send_message(Message(constants.MSG_TYPE_S2S_AGG_DEADLINE, 0, 0))
+        assert fi3.injected["drop"] == 0
+
+    def test_fired_delay_timers_are_released(self):
+        import time
+
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, delay_prob=1.0, delay_s=0.01)
+        for _ in range(20):
+            fi.send_message(self._msg())
+        time.sleep(0.5)
+        assert len(rec.sent) == 20
+        assert fi._timers == []
+
+    def test_delay_reorders(self):
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, delay_prob=1.0, delay_s=0.2, max_faults=1)
+        fi.send_message(self._msg(t=3))  # delayed
+        fi.send_message(self._msg(t=5))  # immediate
+        assert [m.get_type() for m in rec.sent] == [5]
+        import time
+
+        time.sleep(0.4)
+        assert [m.get_type() for m in rec.sent] == [5, 3]
+
+    def test_wrap_validation(self, args_factory):
+        a = args_factory()
+        assert maybe_wrap_faulty("com", a) == "com"  # no spec -> untouched
+        a.fault_injection = {"drop_prob": 0.1, "bogus": 1}
+        with pytest.raises(ValueError, match="bogus"):
+            maybe_wrap_faulty(_RecordingTransport(), a)
+
+    def test_extras_pass_through(self):
+        class T(_RecordingTransport):
+            def destroy_fabric(self):
+                return "destroyed"
+
+        fi = FaultInjector(T())
+        assert fi.destroy_fabric() == "destroyed"
+
+
+class TestFaultsMeetFailureHandling:
+    def test_dropped_upload_recovered_by_deadline_cohort(self, args_factory):
+        """One client's round-0 upload vanishes; with a deadline the
+        server aggregates the 3 that arrived and the federation still
+        completes all rounds."""
+        import fedml_tpu
+        from fedml_tpu.cross_silo import Client, Server
+        from fedml_tpu.data import load as _load
+
+        def make(rank, **kw):
+            a = _mk_args(args_factory, "faults_drop", "LOCAL",
+                         aggregation_deadline_s=3.0, **kw)
+            a.rank = rank
+            a = fedml_tpu.init(a)
+            ds = _load(a)
+            return a, ds, models.create(a, ds.class_num)
+
+        a0, ds0, m0 = make(0)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, 5):
+            kw = {}
+            if r == 2:  # this client's first upload is dropped
+                kw["fault_injection"] = {
+                    "drop_prob": 1.0,
+                    "msg_types": [constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER],
+                    "max_faults": 1,
+                }
+            a, ds, m = make(r, **kw)
+            clients.append(Client(a, None, ds, m))
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert server.manager.round_idx == 3  # all rounds completed
+        assert server.manager.stragglers_dropped == 1
+
+    def test_seed_is_decorrelated_per_rank(self, args_factory):
+        """The same YAML spec must NOT give every process an identical
+        fault pattern — that manufactures correlated failures (every
+        client losing the same round's uplink at once)."""
+        patterns = []
+        for rank in (1, 2):
+            a = args_factory()
+            a.rank = rank
+            a.fault_injection = {"drop_prob": 0.5, "seed": 0}
+            fi = maybe_wrap_faulty(_RecordingTransport(), a)
+            pattern = [fi._rng.random_sample() < 0.5 for _ in range(64)]
+            patterns.append(pattern)
+        assert patterns[0] != patterns[1]
+
+    def test_all_uplinks_lost_recovered_by_rebroadcast(self, args_factory):
+        """Correlated loss of EVERY round-0 upload: the deadline fires
+        with zero uploads, the server rebroadcasts the round, clients
+        retrain (deterministically — same round rng) and the federation
+        completes with the same global model as a clean run."""
+        clean = _run_world(args_factory, run_id="faults_rb_clean", backend="LOCAL")
+        lossy = _run_world(
+            args_factory,
+            run_id="faults_rb_lossy",
+            backend="LOCAL",
+            aggregation_deadline_s=2.0,
+            fault_injection={
+                "drop_prob": 1.0,
+                "msg_types": [constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER],
+                "max_faults": 1,
+            },
+        )
+        assert lossy.manager.round_idx == 3  # all rounds completed
+        assert lossy.manager.stragglers_dropped == 0  # recovered, not dropped
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            clean.aggregator.get_global_model_params(),
+            lossy.aggregator.get_global_model_params(),
+        )
+
+    def test_total_uplink_loss_gives_up_not_livelock(self, args_factory):
+        """A network that eats every upload forever must terminate the
+        federation after the configured extensions, not re-arm the
+        deadline for eternity."""
+        server = _run_world(
+            args_factory,
+            run_id="faults_giveup",
+            backend="LOCAL",
+            aggregation_deadline_s=0.5,
+            aggregation_deadline_max_extensions=1,
+            fault_injection={
+                "drop_prob": 1.0,
+                "msg_types": [constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER],
+            },
+        )
+        assert server.manager.round_idx == 0  # no round ever completed
+
+    def test_duplicated_uploads_are_idempotent(self, args_factory):
+        """At-least-once delivery: every upload sent twice must yield
+        the SAME global model as exactly-once delivery."""
+        clean = _run_world(args_factory, run_id="faults_clean", backend="LOCAL")
+        dup = _run_world(
+            args_factory,
+            run_id="faults_dup",
+            backend="LOCAL",
+            fault_injection={
+                "duplicate_prob": 1.0,
+                "msg_types": [constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER],
+            },
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            clean.aggregator.get_global_model_params(),
+            dup.aggregator.get_global_model_params(),
+        )
