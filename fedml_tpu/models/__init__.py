@@ -189,4 +189,26 @@ def create(args, output_dim: int) -> FedModel:
             example_shape=(seq_len,),
             example_dtype=jnp.int32,
         )
+    if name == "moe_transformer":
+        from .moe import MoETransformerLM
+
+        vocab = int(getattr(args, "vocab_size", 1000))
+        seq_len = int(getattr(args, "seq_len", 64))
+        return FedModel(
+            name="moe_transformer_lm",
+            module=MoETransformerLM(
+                vocab_size=vocab,
+                num_layers=int(getattr(args, "num_layers", 2)),
+                num_heads=int(getattr(args, "num_heads", 4)),
+                embed_dim=int(getattr(args, "embed_dim", 128)),
+                max_len=max(seq_len, int(getattr(args, "max_len", 512))),
+                num_experts=int(getattr(args, "num_experts", 8)),
+                capacity_factor=float(getattr(args, "capacity_factor", 1.25)),
+                moe_every=int(getattr(args, "moe_every", 2)),
+                attention=getattr(args, "attention_impl", "full"),
+            ),
+            task="nwp",
+            example_shape=(seq_len,),
+            example_dtype=jnp.int32,
+        )
     raise ValueError(f"model {name!r} (dataset {ds!r}) not in the model hub")

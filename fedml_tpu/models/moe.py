@@ -1,0 +1,103 @@
+"""Mixture-of-Experts transformer (Switch-style top-1 routing).
+
+The reference has NO MoE and no expert parallelism (SURVEY.md §2.9
+census) — green-field TPU design. The layer follows the GShard/Switch
+dispatch pattern that maps cleanly onto the MXU and XLA SPMD:
+
+- routing is a single dense ``router`` matmul + argmax (static shapes,
+  no data-dependent control flow — jit-safe);
+- token -> expert dispatch is expressed as einsums against 0/1
+  dispatch/combine tensors ``[N, E, cap]`` instead of gather/scatter,
+  so the whole layer is three batched matmuls XLA can tile;
+- each expert has a fixed ``capacity = ceil(N / E * capacity_factor)``;
+  overflow tokens are dropped (their FFN contribution is zero and the
+  residual connection carries them through) — the standard Switch
+  trade for static shapes;
+- expert weights live in stacked arrays ``wi: [E, C, H]``,
+  ``wo: [E, H, C]``. Expert parallelism = sharding that leading E axis
+  over a mesh ``ep`` axis (``parallel.expert.shard_params_ep``); XLA
+  partitions the dispatch einsums and inserts the all-to-alls.
+
+The Switch load-balancing auxiliary loss (E * sum_e f_e * P_e) is
+exposed via ``sow("intermediates", "moe_aux_loss", ...)`` so a training
+step can pull it out with ``mutable=["intermediates"]``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerLM
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 routed MoE feed-forward: [B, T, C] -> [B, T, C]."""
+
+    num_experts: int
+    capacity_factor: float = 1.25
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        N, E = B * T, self.num_experts
+        H = self.mlp_ratio * C
+        cap = max(1, math.ceil(N / E * self.capacity_factor))
+        xf = x.reshape(N, C)
+
+        # -- routing -------------------------------------------------
+        logits = nn.Dense(E, use_bias=False, name="router")(xf)
+        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        gate = jnp.max(probs, axis=-1)           # [N]
+        expert = jnp.argmax(probs, axis=-1)      # [N]
+        onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)  # [N, E]
+
+        # Switch aux loss: E * sum_e (dispatch fraction * mean prob)
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss", E * jnp.sum(frac * mean_prob))
+
+        # -- capacity + dispatch/combine tensors ---------------------
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        keep = onehot * (pos < cap)                        # [N, E]
+        disp = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), cap, dtype=x.dtype
+        )  # [N, E, cap]
+        combine = disp * gate[:, None, None]               # [N, E, cap]
+
+        # -- expert computation (three batched matmuls) --------------
+        wi = self.param("wi", nn.initializers.lecun_normal(), (E, C, H))
+        bi = self.param("bi", nn.initializers.zeros, (E, H))
+        wo = self.param("wo", nn.initializers.lecun_normal(), (E, H, C))
+        bo = self.param("bo", nn.initializers.zeros, (E, C))
+        expert_in = jnp.einsum("nec,nd->ecd", disp, xf)          # [E, cap, C]
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, wi) + bi[:, None])
+        out = jnp.einsum("ech,ehd->ecd", h, wo) + bo[:, None]    # [E, cap, C]
+        y = jnp.einsum("nec,ecd->nd", combine, out)              # [N, C]
+        return y.reshape(B, T, C)
+
+
+class MoETransformerLM(TransformerLM):
+    """``TransformerLM`` with routed FFNs every ``moe_every`` blocks
+    (the attention path, embeddings and head are inherited — one body
+    to maintain, and the tp layout rules apply to both variants)."""
+
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_every: int = 2  # MoE on layers where (i+1) % moe_every == 0
+
+    def make_block(self, i: int, attn: Callable) -> nn.Module:
+        if (i + 1) % self.moe_every != 0:
+            return super().make_block(i, attn)
+        ffn = functools.partial(
+            SwitchFFN,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+        )
+        return Block(num_heads=self.num_heads, attn_fn=attn, ffn=ffn)

@@ -53,9 +53,15 @@ def resolve_attention(name_or_fn) -> Callable:
 
 
 class Block(nn.Module):
+    """Pre-LN block. ``ffn`` swaps the feed-forward half for another
+    module (e.g. a routed ``models.moe.SwitchFFN``) without touching
+    the attention path; the default inline MLP keeps the historical
+    ``Dense_2``/``Dense_3`` param names the tp layout rules key on."""
+
     num_heads: int
     mlp_ratio: int = 4
     attn_fn: Callable = _dense_attention
+    ffn: Optional[Callable[[], nn.Module]] = None  # factory, not module
 
     @nn.compact
     def __call__(self, x):
@@ -67,6 +73,8 @@ class Block(nn.Module):
         o = self.attn_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
         x = x + nn.Dense(C)(o.reshape(B, T, C))
         h = nn.LayerNorm()(x)
+        if self.ffn is not None:
+            return x + self.ffn()(h)
         h = nn.Dense(self.mlp_ratio * C)(h)
         h = nn.gelu(h)
         return x + nn.Dense(C)(h)
@@ -83,6 +91,11 @@ class TransformerLM(nn.Module):
     attention: str = "full"
     attn_fn: Optional[Callable] = None
 
+    def make_block(self, i: int, attn: Callable) -> nn.Module:
+        """Layer ``i``'s block; subclasses override (MoETransformerLM
+        swaps in routed FFNs on a stride)."""
+        return Block(num_heads=self.num_heads, attn_fn=attn)
+
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         attn = self.attn_fn or resolve_attention(self.attention)
@@ -90,7 +103,7 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.embed_dim)(tokens.astype(jnp.int32))
         pos = nn.Embed(self.max_len, self.embed_dim)(jnp.arange(T))
         x = x + pos[None]
-        for _ in range(self.num_layers):
-            x = Block(num_heads=self.num_heads, attn_fn=attn)(x)
+        for i in range(self.num_layers):
+            x = self.make_block(i, attn)(x)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

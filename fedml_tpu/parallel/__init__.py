@@ -1,3 +1,23 @@
-"""Parallelism layer: mesh construction, sharded FL, in-silo SPMD."""
+"""Parallelism layer: mesh construction, sharded FL, in-silo SPMD.
+
+Axes vocabulary (compose freely on one Mesh):
+  clients/data — FL process-parallelism / in-client DP (mesh.py)
+  sp           — sequence/context parallelism: ring + Ulysses (sequence.py)
+  tp           — Megatron-style tensor parallelism (tensor.py)
+  pp           — GPipe pipeline schedule under shard_map (pipeline.py)
+  ep           — expert parallelism for MoE stacks (expert.py)
+"""
 
 from .mesh import build_mesh, shard_federation, replicate  # noqa: F401
+from .tensor import shard_params_tp, tp_specs  # noqa: F401
+from .expert import (  # noqa: F401
+    ep_specs,
+    shard_params_ep,
+    shard_params_tp_ep,
+    tp_ep_specs,
+)
+from .pipeline import (  # noqa: F401
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)

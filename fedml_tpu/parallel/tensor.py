@@ -1,0 +1,105 @@
+"""Tensor parallelism: Megatron-style sharding rules for TransformerLM.
+
+The reference has NO tensor parallelism (SURVEY.md §2.9 census: its
+model-partition vocabulary stops at SplitNN/VFL activation exchange) —
+this is green-field TPU design. The TPU idiom is NOT hand-written
+collectives: weights get ``NamedSharding``s over a mesh ``tp`` axis,
+activations get ``with_sharding_constraint`` hints, and XLA's SPMD
+partitioner inserts the all-reduces exactly where Megatron-LM places
+them by hand (one psum after attention proj, one after the MLP down
+projection — the classic column-parallel -> row-parallel pairing):
+
+- qkv projection   (``Block_*/Dense_0``): column-parallel — kernel
+  sharded on the OUTPUT dim (head math is embarrassingly parallel;
+  XLA re-shards across the packed q/k/v split as needed);
+- attention proj   (``Block_*/Dense_1``): row-parallel — kernel sharded
+  on the INPUT dim; XLA emits the psum that merges head groups;
+- MLP up           (``Block_*/Dense_2``): column-parallel;
+- MLP down         (``Block_*/Dense_3``): row-parallel;
+- LM head          (top-level ``Dense_0``): column-parallel over the
+  vocab — the cross-entropy then runs on vocab-sharded logits;
+- embeddings / LayerNorms: replicated (tiny).
+
+Because SPMD partitioning is semantics-preserving, a tp-sharded step
+computes bit-for-bit the same function as a replicated one — the tests
+assert that equality AND that the weights are genuinely sharded (the
+addressable shard of each column-parallel kernel is 1/tp of the full
+kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (shard_output_dim?, shard_input_dim?) per Dense index inside a Block
+_BLOCK_DENSE_RULES = {
+    "Dense_0": "column",  # qkv
+    "Dense_1": "row",     # attention output proj
+    "Dense_2": "column",  # mlp up
+    "Dense_3": "row",     # mlp down
+}
+
+
+def _spec_for(path: Tuple[str, ...], leaf, axis: str) -> P:
+    names = [p.key if hasattr(p, "key") else str(p) for p in path]
+    in_block = any("Block_" in n for n in names)  # Block_* and MoEBlock_*
+    dense = next((n for n in names if n.startswith("Dense_")), None)
+    kind = names[-1]  # "kernel" | "bias" | "embedding" | "scale" ...
+    if dense is None:
+        return P()  # embeddings, layernorms
+    if in_block:
+        rule = _BLOCK_DENSE_RULES.get(dense)
+        if rule is None:
+            return P()
+    else:
+        rule = "column"  # top-level LM head: vocab-sharded
+    if rule == "column":
+        if kind == "kernel":
+            return P(None, axis)
+        if kind == "bias":
+            return P(axis)
+        return P()
+    # row-parallel: kernel sharded on input dim, bias replicated (it is
+    # added AFTER the psum merges partial sums)
+    if kind == "kernel":
+        return P(axis, None)
+    return P()
+
+
+def tp_specs(params: Any, axis: str = "tp") -> Any:
+    """PartitionSpec pytree for a ``TransformerLM`` param tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for(path, leaf, axis), params
+    )
+
+
+def shard_params_tp(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
+    """Place a TransformerLM param tree on ``mesh`` with the Megatron
+    layout. Dims that don't divide the tp axis fall back to replicated
+    (XLA would error on ragged shards; a warning-free fallback keeps
+    tiny test models usable on big meshes)."""
+    tp = mesh.shape[axis]
+
+    def place(path, leaf):
+        spec = _spec_for(path, leaf, axis)
+        for dim, name in enumerate(spec):
+            if name == axis and leaf.shape[dim] % tp != 0:
+                spec = P()
+                break
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def shard_batch_dp(batch: Any, mesh: Mesh, axis: str = "dp") -> Any:
+    """Shard the leading (batch) axis of every leaf over ``axis``."""
+    if axis not in mesh.axis_names:
+        return batch
+    return jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), batch
+    )
+
+
