@@ -106,6 +106,9 @@ _DEFAULTS: Dict[str, Any] = {
     "stddev": 0.158,
     # precision: the 3-decimal equivalence oracles need f32 matmuls
     "matmul_precision": "highest",
+    # mixed precision (core/local_trainer.py): "bfloat16" runs the
+    # forward/backward matmuls in the MXU's native format with f32
+    # master weights, optimizer state, and loss reductions
     "dtype": "float32",
 }
 
@@ -182,6 +185,9 @@ class Arguments:
         }
         if t not in valid:
             raise ValueError(f"unknown training_type {t!r}; expected one of {sorted(valid)}")
+        from .core.local_trainer import compute_dtype_from_args
+
+        compute_dtype_from_args(self)  # single choke point; raises on bad dtype
         if self.client_num_per_round > self.client_num_in_total:
             self.client_num_per_round = self.client_num_in_total
         if (

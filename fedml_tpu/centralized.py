@@ -15,7 +15,11 @@ from typing import Dict, List
 
 import jax
 
-from .core.local_trainer import make_eval_fn, make_local_train_fn
+from .core.local_trainer import (
+    compute_dtype_from_args,
+    make_eval_fn,
+    make_local_train_fn,
+)
 from .core.optimizers import create_client_optimizer
 
 
@@ -35,9 +39,15 @@ class CentralizedTrainer:
                 create_client_optimizer(args),
                 epochs=1,
                 shuffle=bool(getattr(args, "shuffle", True)),
+                compute_dtype=compute_dtype_from_args(args),
             )
         )
-        self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+        self._eval = jax.jit(
+            make_eval_fn(
+                model.apply, model.loss_fn,
+                compute_dtype=compute_dtype_from_args(args),
+            )
+        )
 
     def train(self) -> Dict[str, float]:
         epochs = int(getattr(self.args, "epochs", 1))

@@ -111,11 +111,17 @@ class ClientTrainer(abc.ABC):
     def test(self, test_data, device=None, args=None):
         import jax
 
-        from .local_trainer import make_eval_fn
+        from .local_trainer import compute_dtype_from_args, make_eval_fn
 
         if self._jitted_eval is None:
             self._jitted_eval = jax.jit(
-                make_eval_fn(self.model.apply, self.model.loss_fn)
+                make_eval_fn(
+                    self.model.apply,
+                    self.model.loss_fn,
+                    compute_dtype=compute_dtype_from_args(
+                        args if args is not None else self.args
+                    ),
+                )
             )
         return self.model.metrics_from_sums(self._jitted_eval(self.params, test_data))
 
@@ -132,7 +138,7 @@ class DefaultClientTrainer(ClientTrainer):
     trainer is passed."""
 
     def make_train_fn(self, args) -> TrainFn:
-        from .local_trainer import make_local_train_fn
+        from .local_trainer import compute_dtype_from_args, make_local_train_fn
         from .optimizers import create_client_optimizer
 
         return make_local_train_fn(
@@ -142,6 +148,7 @@ class DefaultClientTrainer(ClientTrainer):
             epochs=int(args.epochs),
             prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
             shuffle=bool(getattr(args, "shuffle", True)),
+            compute_dtype=compute_dtype_from_args(args),
         )
 
 
@@ -179,11 +186,17 @@ class ServerAggregator(abc.ABC):
     def test(self, test_data, device=None, args=None):
         import jax
 
-        from .local_trainer import make_eval_fn
+        from .local_trainer import compute_dtype_from_args, make_eval_fn
 
         if self._jitted_eval is None:
             self._jitted_eval = jax.jit(
-                make_eval_fn(self.model.apply, self.model.loss_fn)
+                make_eval_fn(
+                    self.model.apply,
+                    self.model.loss_fn,
+                    compute_dtype=compute_dtype_from_args(
+                        args if args is not None else self.args
+                    ),
+                )
             )
         return self.model.metrics_from_sums(self._jitted_eval(self.params, test_data))
 

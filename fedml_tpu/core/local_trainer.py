@@ -19,12 +19,17 @@ TPU-native replacement for the reference's per-client torch loop
   the vectorized simulator (vmap), and the mesh simulator
   (shard_map(vmap)) without code changes;
 - optional FedProx proximal term (mu/2 ||w - w_global||^2,
-  ``fedprox`` trainer semantics) so FedProx is a config flag, not a fork.
+  ``fedprox`` trainer semantics) so FedProx is a config flag, not a fork;
+- optional mixed precision (``args.dtype: bfloat16``): the forward/
+  backward matmuls run in bf16 — the MXU's native format — while master
+  params, optimizer state, the loss reduction, and the prox term stay
+  f32 (params are cast INSIDE the loss so autodiff returns f32 grads to
+  the f32 master copy; logits are cast back to f32 before the softmax).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +38,32 @@ import optax
 from .types import Batches, flat_examples, rebatch
 
 Params = Any
+
+# float16 is deliberately absent: without loss scaling its ~6e-5 normal
+# floor flushes small gradients to zero; bf16 keeps f32's exponent range
+# and is the MXU's native input format, so it needs no scaling
+_DTYPES = {"float32": None, "bfloat16": jnp.bfloat16}
+
+
+def compute_dtype_from_args(args) -> Optional[Any]:
+    """``args.dtype`` -> compute dtype for the hot loop (None = f32,
+    i.e. no casting). The single validation choke point for the knob."""
+    name = str(getattr(args, "dtype", "float32") or "float32")
+    if name not in _DTYPES:
+        raise ValueError(
+            f"dtype {name!r}: pick one of {sorted(_DTYPES)} (float16 is "
+            "unsupported — no loss scaling; use bfloat16 on TPU)"
+        )
+    return _DTYPES[name]
+
+
+def _cast_floats(tree: Any, dtype) -> Any:
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
 
 
 def _shuffle_batches(b: Batches, rng: jax.Array) -> Batches:
@@ -62,6 +93,7 @@ def make_local_train_fn(
     epochs: int,
     prox_mu: float = 0.0,
     shuffle: bool = True,
+    compute_dtype=None,
 ) -> Callable[[Params, Batches, jax.Array], Tuple[Params, Dict[str, jax.Array]]]:
     """Build ``local_train(params, batches, rng) -> (new_params, metrics)``.
 
@@ -70,7 +102,12 @@ def make_local_train_fn(
     """
 
     def batch_loss(params, global_params, x, y, mask):
-        logits = apply_fn(params, x)
+        if compute_dtype is not None:
+            logits = apply_fn(
+                _cast_floats(params, compute_dtype), _cast_floats(x, compute_dtype)
+            ).astype(jnp.float32)
+        else:
+            logits = apply_fn(params, x)
         loss, metrics = loss_fn(logits, y, mask)
         if prox_mu > 0.0:
             sq = sum(
@@ -119,15 +156,23 @@ def make_local_train_fn(
 def make_eval_fn(
     apply_fn: Callable[[Params, jax.Array], jax.Array],
     loss_fn: Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, Dict]],
+    compute_dtype=None,
 ) -> Callable[[Params, Batches], Dict[str, jax.Array]]:
     """Build ``evaluate(params, batches) -> summed metrics`` (scan over
     packed batches; parity with the reference trainers' ``test``,
     my_model_trainer_classification.py:95-154)."""
 
     def evaluate(params: Params, batches: Batches) -> Dict[str, jax.Array]:
+        if compute_dtype is not None:
+            params = _cast_floats(params, compute_dtype)
+
         def step(_, batch):
             x, y, m = batch
+            if compute_dtype is not None:
+                x = _cast_floats(x, compute_dtype)
             logits = apply_fn(params, x)
+            if compute_dtype is not None:
+                logits = logits.astype(jnp.float32)
             loss, metrics = loss_fn(logits, y, m)
             out = {
                 "loss_sum": (loss * metrics["count"]),

@@ -47,9 +47,17 @@ class CrossDeviceAggregator:
         )
         self._eval = None
         if model is not None and test_data is not None:
-            from ..core.local_trainer import make_eval_fn
+            from ..core.local_trainer import (
+                compute_dtype_from_args,
+                make_eval_fn,
+            )
 
-            self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+            self._eval = jax.jit(
+                make_eval_fn(
+                    model.apply, model.loss_fn,
+                    compute_dtype=compute_dtype_from_args(args),
+                )
+            )
 
     # -- round bookkeeping (fedml_aggregator.py:40-70) ----------------
     def add_local_trained_result(self, index: int, model_file_url: str,

@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.frame import bind_operator
-from ...core.local_trainer import make_local_train_fn
+from ...core.local_trainer import compute_dtype_from_args, make_local_train_fn
 from ...core.optimizers import create_client_optimizer
 from ...core.types import Batches
 
@@ -100,6 +100,7 @@ class TrainerDistAdapter:
                 epochs=int(args.epochs),
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
+                compute_dtype=compute_dtype_from_args(args),
             )
         self._fn = jax.jit(
             local_fn,

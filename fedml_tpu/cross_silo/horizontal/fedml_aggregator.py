@@ -23,7 +23,7 @@ from ...core.aggregation import (
     weighted_average,
 )
 from ...core.frame import bind_operator
-from ...core.local_trainer import make_eval_fn
+from ...core.local_trainer import compute_dtype_from_args, make_eval_fn
 
 Params = Any
 
@@ -46,7 +46,12 @@ class FedMLAggregator:
             jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
         )
         self.global_params: Params = model.init(init_rng)
-        self._eval = jax.jit(make_eval_fn(model.apply, model.loss_fn))
+        self._eval = jax.jit(
+            make_eval_fn(
+                model.apply, model.loss_fn,
+                compute_dtype=compute_dtype_from_args(args),
+            )
+        )
 
     def get_global_model_params(self) -> Params:
         return self.global_params

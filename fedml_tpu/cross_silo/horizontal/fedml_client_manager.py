@@ -16,7 +16,7 @@ import jax
 
 from ... import constants
 from ...core.frame import bind_operator
-from ...core.local_trainer import make_local_train_fn
+from ...core.local_trainer import compute_dtype_from_args, make_local_train_fn
 from ...core.managers import ClientManager
 from ...core.message import Message
 from ...core.optimizers import create_client_optimizer
@@ -44,6 +44,7 @@ class FedMLTrainer:
                 epochs=int(args.epochs),
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
+                compute_dtype=compute_dtype_from_args(args),
             )
         self._fn = jax.jit(fn)
 

@@ -35,7 +35,11 @@ from ..core.aggregation import (
     normalize_weights,
     weighted_average,
 )
-from ..core.local_trainer import make_eval_fn, make_local_train_fn
+from ..core.local_trainer import (
+    compute_dtype_from_args,
+    make_eval_fn,
+    make_local_train_fn,
+)
 from ..core.optimizers import create_client_optimizer, create_server_optimizer
 from ..core.types import Batches
 from ..data.loader import FederatedDataset
@@ -148,8 +152,12 @@ class FedAvgAPI:
                 epochs=int(args.epochs),
                 prox_mu=prox_mu,
                 shuffle=bool(getattr(args, "shuffle", True)),
+                compute_dtype=compute_dtype_from_args(args),
             )
-        self._eval = make_eval_fn(model.apply, model.loss_fn)
+        self._eval = make_eval_fn(
+            model.apply, model.loss_fn,
+            compute_dtype=compute_dtype_from_args(args),
+        )
         self.robust = (
             RobustAggregator(args) if getattr(args, "defense_type", None) else None
         )
