@@ -28,7 +28,9 @@ same jitted per-client step so the comparison isolates architecture).
 - ``aggregation_exchange``: device-resident (zero-copy in-process
   reference passing, the TRPC-analog fast path) vs host-hop
   (msgpack serialize + deserialize + device_put, what every reference
-  exchange does) round-trip time for the model tree.
+  exchange does) round-trip time for the model tree;
+- ``bf16``: the same cohort under dtype=bfloat16 (core/local_trainer.py
+  mixed precision) and its speedup over the f32 headline.
 
 Robustness contract (VERDICT round 1, hardened round 3): TPU init is
 probed in a subprocess with a timeout; on failure we retry then fall
@@ -118,7 +120,7 @@ def _force_cpu(n_devices: int = 1) -> None:
     _force_virtual_cpu(n_devices)
 
 
-def _build_api(n_clients: int, epochs: int, per_client: int = 600):
+def _build_api(n_clients: int, epochs: int, per_client: int = 600, **extra):
     import fedml_tpu
     from fedml_tpu import models
     from fedml_tpu.arguments import Arguments
@@ -141,6 +143,7 @@ def _build_api(n_clients: int, epochs: int, per_client: int = 600):
         learning_rate=0.03,
         frequency_of_the_test=10**9,
         matmul_precision="default",
+        **extra,
     ).items():
         setattr(args, k, v)
     args._validate()
@@ -270,6 +273,18 @@ def _aggregation_exchange(model, n_iter: int = 20) -> dict:
     }
 
 
+def _headline_cohort(on_cpu: bool) -> dict:
+    """Shared by the f32 headline and the bf16 phase — their cohorts
+    MUST match or detail.bf16.speedup_vs_f32 compares different work.
+    (Config matches BENCH_r02 for cross-round comparability.)"""
+    return dict(
+        n_clients=8 if on_cpu else 32,
+        epochs=1 if on_cpu else 5,
+        n_rounds=3 if on_cpu else 10,
+        per_client=100 if on_cpu else 600,
+    )
+
+
 def run_headline(on_cpu: bool) -> dict:
     """Headline rounds/s + sequential baseline + MFU + exchange metric
     (everything except the scaling sweep, which runs in isolated
@@ -278,12 +293,10 @@ def run_headline(on_cpu: bool) -> dict:
 
     _progress(f"backend up: {jax.devices()[0]}")
 
-    # headline config matches BENCH_r02 for cross-round comparability
-    n_clients = 8 if on_cpu else 32
-    epochs = 1 if on_cpu else 5
-    n_rounds = 3 if on_cpu else 10
+    cohort = _headline_cohort(on_cpu)
+    n_clients, epochs = cohort["n_clients"], cohort["epochs"]
+    n_rounds, headline_per_client = cohort["n_rounds"], cohort["per_client"]
     n_seq = 1 if on_cpu else 2
-    headline_per_client = 100 if on_cpu else 600
 
     args, dataset, model, api = _build_api(
         n_clients, epochs, per_client=headline_per_client
@@ -336,6 +349,25 @@ def run_headline(on_cpu: bool) -> dict:
         "unit": f"rounds/s ({n_clients} clients x {epochs} epochs, CNN/FEMNIST-shape)",
         "vs_baseline": round(vec_rps / seq_rps, 2),
         "detail": detail,
+    }
+
+
+def run_bf16(on_cpu: bool) -> dict:
+    """Mixed-precision phase: same cohort as the headline but with
+    dtype=bfloat16 (bf16 matmuls, f32 master weights). The speedup over
+    the f32 headline is the MXU's bf16 advantage net of the cast
+    overhead; the parent stitches it into detail.bf16."""
+    cohort = _headline_cohort(on_cpu)
+    args, dataset, _model, api = _build_api(
+        cohort["n_clients"], cohort["epochs"],
+        per_client=cohort["per_client"], dtype="bfloat16",
+    )
+    _progress("bf16 built")
+    rps, spr, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    _progress(f"bf16 timed: {rps:.3f} rounds/s")
+    return {
+        "rounds_per_sec": round(rps, 4),
+        "samples_per_sec": round(rps * spr, 1),
     }
 
 
@@ -399,8 +431,9 @@ def _run_phase_subprocess(phase_args, timeout_s: float):
 # total wall budget: the driver gives bench ~580s. Leave headroom for
 # probe (worst 120s) + interpreter startups.
 _BUDGET_S = 560.0
-_HEADLINE_TIMEOUT_S = 320.0
-_SWEEP_TIMEOUT_S = 90.0
+_HEADLINE_TIMEOUT_S = 290.0
+_BF16_TIMEOUT_S = 110.0
+_SWEEP_TIMEOUT_S = 70.0
 _SWEEP_COHORTS = [8, 32, 256]
 
 
@@ -461,6 +494,23 @@ def _main_guarded() -> None:
         return
 
     if tpu_ok:
+        # mixed-precision point (own child): bf16 vs the f32 headline
+        remaining = _BUDGET_S - _elapsed()
+        if remaining > 100:
+            bf16, bnote = _run_phase_subprocess(
+                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
+            )
+            if bf16 is not None:
+                bf16["speedup_vs_f32"] = round(
+                    bf16["rounds_per_sec"] / max(result["value"], 1e-9), 2
+                )
+                result["detail"]["bf16"] = bf16
+            else:
+                result["detail"]["bf16_skipped"] = bnote
+                _progress(f"bf16 phase skipped ({bnote})")
+        else:
+            result["detail"]["bf16_skipped"] = "budget exhausted"
+
         # scaling sweep, one isolated child per cohort; 256 last so a
         # cohort big enough to wedge the tunnel can only cost itself
         scaling, skipped = [], []
@@ -505,7 +555,7 @@ def _phase_main(argv) -> None:
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--phase", required=True, choices=["headline", "sweep"])
+    p.add_argument("--phase", required=True, choices=["headline", "bf16", "sweep"])
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", required=True)
@@ -514,6 +564,8 @@ def _phase_main(argv) -> None:
         _force_cpu()
     if a.phase == "headline":
         out = run_headline(on_cpu=a.cpu)
+    elif a.phase == "bf16":
+        out = run_bf16(on_cpu=a.cpu)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:
