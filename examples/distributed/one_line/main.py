@@ -1,0 +1,14 @@
+"""Mesh-parallel (distributed) LM training — one line.
+
+No reference counterpart: the reference's parallelism stops at FL
+process-parallelism + in-silo DDP. Here the YAML's ``mesh_shape``
+drives dp x tp x ep sharding, sequence parallelism (sp), or a GPipe
+pipeline (pp) — see ``fedml_tpu/distributed.py``.
+
+Run:  python main.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    print("FINAL:", fedml_tpu.run_distributed())

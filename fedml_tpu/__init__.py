@@ -109,6 +109,24 @@ def run_simulation(
     return simulator.run()
 
 
+def run_distributed(args: Optional[Arguments] = None):
+    """One-line mesh-parallel (distributed) LM training — the
+    ``training_type: distributed`` platform. The YAML's ``mesh_shape``
+    picks the parallelism (dp x tp x ep, sp, or pp); see
+    ``fedml_tpu.distributed``. No reference counterpart: this is where
+    the green-field parallel subsystems surface as product."""
+    global _global_training_type
+    _global_training_type = constants.FEDML_TRAINING_PLATFORM_DISTRIBUTED
+    from . import data, device, models
+    from .distributed import DistributedTrainer
+
+    args = init(args)
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    model = models.create(args, dataset.class_num)
+    return DistributedTrainer(args, dev, dataset, model).run()
+
+
 def run_cross_silo_server(args: Optional[Arguments] = None, server_aggregator=None):
     """One-line cross-silo server (__init__.py:172-191)."""
     global _global_training_type

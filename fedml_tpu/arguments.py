@@ -110,6 +110,11 @@ _DEFAULTS: Dict[str, Any] = {
     # forward/backward matmuls in the MXU's native format with f32
     # master weights, optimizer state, and loss reductions
     "dtype": "float32",
+    # distributed platform (distributed.py): mesh axes -> sizes, e.g.
+    # {dp: 2, tp: 2, ep: 2} or {sp: 8} or {pp: 8}; None = all-dp
+    "mesh_shape": None,
+    "sp_strategy": "ring",  # or "ulysses"
+    "pp_microbatches": 0,  # 0 = auto (2 x pipeline stages)
 }
 
 _SECTIONS = (

@@ -1,0 +1,353 @@
+"""``training_type: distributed`` — mesh-parallel LM training through
+the one-line API.
+
+The reference has no counterpart (its parallelism vocabulary stops at
+FL process-parallelism + in-silo DDP, SURVEY.md §2.9 census); this
+scenario is where the framework's green-field parallel subsystems
+become user-reachable product: the YAML picks a mesh and the trainer
+runs one jitted step over it.
+
+YAML surface::
+
+    common_args: {training_type: distributed}
+    train_args:  {mesh_shape: {dp: 2, tp: 2, ep: 2}, epochs: 2, ...}
+    model_args:  {model: moe_transformer, ...}
+    data_args:   {dataset: shakespeare, ...}
+
+Modes (inferred from the mesh axes):
+
+- **sharded** (axes ⊆ {dp, tp, ep}): one jitted train step; batch over
+  ``dp``, Megatron dense layout over ``tp`` (parallel/tensor.py),
+  expert stacks over ``ep`` (parallel/expert.py). XLA SPMD inserts the
+  collectives; numerics match the single-device program exactly.
+- **sequence** ({sp} alone): ring / Ulysses attention
+  (parallel/sequence.py) with the token axis sharded over ``sp`` —
+  the long-context path. sp must divide the sequence length.
+- **pipeline** ({pp} alone): the block stack is cut into pp stages and
+  scheduled GPipe-style under shard_map (parallel/pipeline.py); the
+  batch is streamed as microbatches. ``num_layers % pp == 0``.
+
+Modes are exclusive by design: pp restructures the program (stage
+functions under shard_map) and the sp attention's shard_map specs pin
+every non-sequence axis unsharded, so composing them silently degrades
+to gathers — better to refuse loudly. dp x tp x ep compose freely.
+
+Training data: the dataset's global packed batches (``[nb, bs, T]``
+int tokens) — this is centralized mesh training, the "distributed"
+platform of the reference's vocabulary, not federated averaging.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .core.local_trainer import _cast_floats, compute_dtype_from_args
+from .core.optimizers import create_client_optimizer
+from .parallel.expert import shard_params_tp_ep
+from .parallel.mesh import build_mesh
+
+_SHARDED_AXES = {"dp", "tp", "ep"}
+_ALL_AXES = _SHARDED_AXES | {"sp", "pp"}
+
+
+def _resolve_mesh(args) -> Mesh:
+    devices = jax.devices()
+    shape = getattr(args, "mesh_shape", None)
+    if not shape:
+        shape = {"dp": len(devices)}
+    shape = {str(k): int(v) for k, v in dict(shape).items()}
+    unknown = set(shape) - _ALL_AXES
+    if unknown:
+        raise ValueError(
+            f"mesh_shape axes {sorted(unknown)} unknown; pick from {sorted(_ALL_AXES)}"
+        )
+    for bad in ("sp", "pp"):
+        if bad in shape and len(shape) > 1:
+            raise ValueError(
+                f"mesh axis {bad!r} is exclusive (program structure differs); "
+                f"got {shape}"
+            )
+    n = int(np.prod(list(shape.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh_shape {shape} needs {n} devices, have {len(devices)}")
+    return build_mesh(devices=devices[:n], mesh_shape=shape)
+
+
+class DistributedTrainer:
+    """One-line distributed LM training over a device mesh."""
+
+    def __init__(self, args, device=None, dataset=None, model=None) -> None:
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.mesh = _resolve_mesh(args)
+        axes = set(self.mesh.axis_names)
+        self.mode = (
+            "pipeline" if "pp" in axes
+            else "sequence" if "sp" in axes
+            else "sharded"
+        )
+        self.compute_dtype = compute_dtype_from_args(args)
+        self.optimizer = create_client_optimizer(args)
+        from .core.tracking import MetricsReporter
+
+        self.metrics_reporter = MetricsReporter(args)
+        init_rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        builder = getattr(self, f"_build_{self.mode}")
+        builder(init_rng)
+
+    # -- shared pieces -------------------------------------------------
+    def _loss(self, logits, y, mask):
+        loss, metrics = self.model.loss_fn(logits.astype(jnp.float32), y, mask)
+        return loss, metrics
+
+    def _epoch_scanner(self, apply_fn):
+        """(params, opt_state, batches) -> scan of optimizer steps."""
+        optimizer = self.optimizer
+        dtype = self.compute_dtype
+
+        def step(carry, batch):
+            params, opt_state = carry
+            x, y, m = batch
+
+            def loss_fn(p):
+                if dtype is not None:
+                    p = _cast_floats(p, dtype)
+                    x_ = _cast_floats(x, dtype)
+                else:
+                    x_ = x
+                return self._loss(apply_fn(p, x_), y, m)
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        def epoch(params, opt_state, batches):
+            (params, opt_state), metrics = jax.lax.scan(
+                step, (params, opt_state), (batches.x, batches.y, batches.mask)
+            )
+            return params, opt_state, {
+                "loss_sum": (metrics["loss"] * metrics["count"]).sum(),
+                "correct": metrics["correct"].sum(),
+                "count": metrics["count"].sum(),
+            }
+
+        return epoch
+
+    # -- sharded: dp x tp x ep ----------------------------------------
+    def _build_sharded(self, init_rng) -> None:
+        if "dp" in self.mesh.axis_names:
+            bs = int(self.dataset.train_data_global.x.shape[1])
+            dp = self.mesh.shape["dp"]
+            if bs % dp:
+                raise ValueError(
+                    f"mesh axis dp={dp} must divide batch_size {bs}"
+                )
+        params = self.model.init(init_rng)
+        self.params = shard_params_tp_ep(params, self.mesh)
+        self.opt_state = self.optimizer.init(self.params)
+        batch_spec = P(None, "dp") if "dp" in self.mesh.axis_names else P()
+        self._place_data = lambda b: jax.device_put(
+            b, NamedSharding(self.mesh, batch_spec)
+        )
+        self._epoch = jax.jit(self._epoch_scanner(self.model.apply))
+        self._eval_apply = self.model.apply
+
+    # -- sequence: sp (ring / Ulysses attention) ----------------------
+    def _build_sequence(self, init_rng) -> None:
+        import dataclasses
+
+        from .parallel.sequence import make_sequence_sharded_attention
+
+        module = self.model.module
+        if not hasattr(module, "attn_fn"):
+            raise ValueError(
+                f"model {self.model.name!r} has no pluggable attention; "
+                "sequence parallelism needs the transformer family"
+            )
+        sp = self.mesh.shape["sp"]
+        strategy = str(getattr(self.args, "sp_strategy", "ring") or "ring")
+        attn = make_sequence_sharded_attention(
+            self.mesh, strategy=strategy, causal=True
+        )
+        sp_module = module.clone(attn_fn=attn)
+        self.model = dataclasses.replace(self.model, module=sp_module)
+        seq_len = int(self.dataset.train_data_global.x.shape[-1])
+        if seq_len % sp:
+            raise ValueError(f"mesh axis sp={sp} must divide seq_len {seq_len}")
+        params = self.model.init(
+            init_rng,
+            example_x=jnp.zeros((1, seq_len), jnp.int32),
+        )
+        from .parallel.mesh import replicate
+
+        self.params = replicate(params, self.mesh)
+        self.opt_state = self.optimizer.init(self.params)
+        # x/y [nb, bs, T]: token axis over sp; the per-example mask
+        # [nb, bs] (and any rank<3 leaf) stays replicated — the
+        # attention shard_map pins non-sequence axes anyway
+        def place(b):
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    a,
+                    NamedSharding(
+                        self.mesh, P(None, None, "sp") if a.ndim >= 3 else P()
+                    ),
+                ),
+                b,
+            )
+
+        self._place_data = place
+        self._epoch = jax.jit(self._epoch_scanner(self.model.apply))
+        self._eval_apply = self.model.apply
+
+    # -- pipeline: pp (GPipe over the block stack) --------------------
+    def _build_pipeline(self, init_rng) -> None:
+        from .models.transformer import TransformerLM
+        from .parallel.pipeline import stack_stage_params
+
+        module = self.model.module
+        if type(module) is not TransformerLM:
+            raise ValueError(
+                f"pipeline mode supports the plain TransformerLM block "
+                f"stack, got {type(module).__name__}"
+            )
+        S = self.mesh.shape["pp"]
+        L = int(module.num_layers)
+        if L % S:
+            raise ValueError(f"num_layers {L} must divide pp={S}")
+        self._layers_per_stage = L // S
+        self._pp_module = module
+        params = self.model.init(
+            init_rng, example_x=jnp.zeros((1, 8), jnp.int32)
+        )
+        blocks = [params[f"Block_{i}"] for i in range(L)]
+        # [S, L/S, ...] — stage-major stacking
+        stages = stack_stage_params(
+            [
+                jax.tree.map(
+                    lambda *xs: jnp.stack(xs),
+                    *blocks[s * self._layers_per_stage:(s + 1) * self._layers_per_stage],
+                )
+                for s in range(S)
+            ]
+        )
+        outer = {k: v for k, v in params.items() if not k.startswith("Block_")}
+        # _pp_apply mirrors TransformerLM.__call__'s embed/head halves;
+        # refuse loudly if the model grows top-level params this mirror
+        # doesn't know about (silent divergence otherwise)
+        expected = {"Embed_0", "Embed_1", "LayerNorm_0", "Dense_0"}
+        if set(outer) != expected:
+            raise ValueError(
+                "pipeline mode mirrors TransformerLM's embed/head "
+                f"structure; unexpected params: {sorted(set(outer) ^ expected)}"
+            )
+        self.params = {"outer": outer, "stages": stages}
+        self.opt_state = self.optimizer.init(self.params)
+        self._place_data = lambda b: jax.device_put(
+            b, NamedSharding(self.mesh, P())
+        )
+        self._epoch = jax.jit(self._epoch_scanner(self._pp_apply))
+        self._eval_apply = self._pp_apply
+
+    def _pp_apply(self, params, tokens):
+        """TransformerLM forward with the block stack pipelined.
+        Mirrors ``TransformerLM.__call__`` (embed -> blocks -> LN ->
+        head) with the middle replaced by the GPipe schedule; the
+        embed/LN/head math is flax's own layer modules applied to the
+        original param subtrees, and the structure mirror is guarded by
+        the ``expected`` check in ``_build_pipeline``."""
+        import flax.linen as nn
+
+        from .models.transformer import Block, resolve_attention
+        from .parallel.pipeline import pipeline_apply, split_microbatches
+
+        m = self._pp_module
+        outer, stages = params["outer"], params["stages"]
+        attn = m.attn_fn or resolve_attention(m.attention)
+        block = Block(num_heads=m.num_heads, attn_fn=attn)
+        B, T = tokens.shape
+        x = nn.Embed(m.vocab_size, m.embed_dim).apply(
+            {"params": outer["Embed_0"]}, tokens.astype(jnp.int32)
+        )
+        pos = nn.Embed(m.max_len, m.embed_dim).apply(
+            {"params": outer["Embed_1"]}, jnp.arange(T)
+        )
+        x = x + pos[None]
+
+        def stage_fn(stage_params, h):
+            def one_block(h, bp):
+                return block.apply({"params": bp}, h), None
+
+            h, _ = jax.lax.scan(one_block, h, stage_params)
+            return h
+
+        micro = int(getattr(self.args, "pp_microbatches", 0) or 0)
+        if micro <= 0:
+            micro = min(B, max(2 * self.mesh.shape["pp"], 1))
+            while B % micro:
+                micro -= 1
+        out = pipeline_apply(
+            stage_fn, stages, split_microbatches(x, micro), self.mesh
+        )
+        x = out.reshape(B, T, -1)
+        x = nn.LayerNorm().apply({"params": outer["LayerNorm_0"]}, x)
+        return nn.Dense(m.vocab_size).apply({"params": outer["Dense_0"]}, x)
+
+    # -- run loop ------------------------------------------------------
+    def run(self) -> Dict[str, float]:
+        args, ds = self.args, self.dataset
+        train = self._place_data(ds.train_data_global)
+        test = self._place_data(ds.test_data_global)
+        epochs = int(getattr(args, "epochs", 1))
+        stats: Dict[str, float] = {}
+        eval_every = int(getattr(args, "frequency_of_the_test", 1) or 1)
+        with self.mesh:
+            for ep in range(epochs):
+                t0 = time.perf_counter()
+                self.params, self.opt_state, sums = self._epoch(
+                    self.params, self.opt_state, train
+                )
+                jax.block_until_ready(jax.tree.leaves(self.params)[0])
+                dt = time.perf_counter() - t0
+                train_m = self.model.metrics_from_sums(
+                    jax.tree.map(np.asarray, sums)
+                )
+                stats = {
+                    "epoch": ep,
+                    "train_loss": train_m["loss"],
+                    "train_acc": train_m["acc"],
+                    "epoch_time_s": dt,
+                    "tokens_per_sec": train_m["count"] / max(dt, 1e-9),
+                }
+                if (ep + 1) % eval_every == 0 or ep == epochs - 1:
+                    stats.update(self._evaluate(test))
+                self.metrics_reporter.report(
+                    {"kind": "distributed_train", **stats}
+                )
+                logging.info("distributed epoch %d: %s", ep, stats)
+        return stats
+
+    def _evaluate(self, test) -> Dict[str, float]:
+        from .core.local_trainer import make_eval_fn
+
+        if not hasattr(self, "_eval_jit"):
+            self._eval_jit = jax.jit(
+                make_eval_fn(
+                    self._eval_apply, self.model.loss_fn,
+                    compute_dtype=self.compute_dtype,
+                )
+            )
+        m = self.model.metrics_from_sums(
+            jax.tree.map(np.asarray, self._eval_jit(self.params, test))
+        )
+        return {"test_loss": m["loss"], "test_acc": m["acc"]}

@@ -127,8 +127,12 @@ def create(args, output_dim: int) -> FedModel:
             example_shape=_example_shape(args, (32, 32, 3)),
         )
     if name == "rnn":
+        # vocab must cover the dataset's token ids: an undersized vocab
+        # makes every OOB embed lookup NaN-fill (eager) or silently
+        # clamp (jit) — so the dataset's class_num is the floor. An
+        # explicit vocab_size still wins over the historical default.
         if "stackoverflow" in ds:
-            vocab = int(getattr(args, "vocab_size", 10004))
+            vocab = max(int(getattr(args, "vocab_size", 0) or 10004), output_dim)
             return FedModel(
                 name="rnn_stackoverflow",
                 module=RNNStackOverflow(vocab_size=vocab),
@@ -136,7 +140,7 @@ def create(args, output_dim: int) -> FedModel:
                 example_shape=(int(getattr(args, "seq_len", 20)),),
                 example_dtype=jnp.int32,
             )
-        vocab = int(getattr(args, "vocab_size", 90))
+        vocab = max(int(getattr(args, "vocab_size", 0) or 90), output_dim)
         return FedModel(
             name="rnn_fedavg",
             module=RNNOriginalFedAvg(vocab_size=vocab),
@@ -173,7 +177,8 @@ def create(args, output_dim: int) -> FedModel:
     if name == "transformer":
         from .transformer import TransformerLM
 
-        vocab = int(getattr(args, "vocab_size", 1000))
+        # class_num is the floor (see the rnn branch note on OOB lookups)
+        vocab = max(int(getattr(args, "vocab_size", 0) or 0), output_dim)
         seq_len = int(getattr(args, "seq_len", 64))
         return FedModel(
             name="transformer_lm",
@@ -192,7 +197,7 @@ def create(args, output_dim: int) -> FedModel:
     if name == "moe_transformer":
         from .moe import MoETransformerLM
 
-        vocab = int(getattr(args, "vocab_size", 1000))
+        vocab = max(int(getattr(args, "vocab_size", 0) or 0), output_dim)
         seq_len = int(getattr(args, "seq_len", 64))
         return FedModel(
             name="moe_transformer_lm",

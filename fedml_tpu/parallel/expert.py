@@ -40,8 +40,13 @@ def ep_specs(params: Any, axis: str = "ep") -> Any:
 
 def shard_params_ep(params: Any, mesh: Mesh, axis: str = "ep") -> Any:
     """Place an MoE param tree on ``mesh`` with experts split over
-    ``axis``. Expert counts that don't divide the axis fall back to
-    replicated (same policy as ``tensor.shard_params_tp``)."""
+    ``axis``. Expert counts that don't divide the axis — or a mesh
+    without the axis at all — fall back to replicated (same policy as
+    ``tensor.shard_params_tp``)."""
+    if axis not in mesh.axis_names:
+        from .mesh import replicate
+
+        return replicate(params, mesh)
     ep = mesh.shape[axis]
 
     def place(path, leaf):
@@ -78,7 +83,10 @@ def shard_params_tp_ep(
 
     def place(leaf, spec):
         for dim, name in enumerate(spec):
-            if name is not None and leaf.shape[dim] % mesh.shape[name] != 0:
+            if name is not None and (
+                name not in mesh.axis_names
+                or leaf.shape[dim] % mesh.shape[name] != 0
+            ):
                 spec = P()
                 break
         return jax.device_put(leaf, NamedSharding(mesh, spec))

@@ -80,7 +80,12 @@ def shard_params_tp(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
     """Place a TransformerLM param tree on ``mesh`` with the Megatron
     layout. Dims that don't divide the tp axis fall back to replicated
     (XLA would error on ragged shards; a warning-free fallback keeps
-    tiny test models usable on big meshes)."""
+    tiny test models usable on big meshes). A mesh without the axis
+    replicates everything."""
+    if axis not in mesh.axis_names:
+        from .mesh import replicate
+
+        return replicate(params, mesh)
     tp = mesh.shape[axis]
 
     def place(path, leaf):

@@ -1,0 +1,155 @@
+"""training_type: distributed (distributed.py) on the 8-device CPU mesh.
+
+The user-reachable surface for the parallel subsystems: mesh from the
+YAML, one jitted LM train step over it. Oracles: every mesh mode
+produces the same numerics as the single-device program (sharded modes
+exactly; sp/pp within fp tolerance of the dense/sequential oracle),
+and the mode/mesh validation refuses bad configs loudly.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import data, models
+from fedml_tpu.distributed import DistributedTrainer, _resolve_mesh
+
+# only the fast validation tests ride the smoke tier; the mode oracles
+# train full trajectories (~6 min on the virtual mesh)
+
+
+def _args(args_factory, **kw):
+    base = dict(
+        training_type="distributed",
+        dataset="shakespeare",
+        synthetic_train_size=64,
+        synthetic_test_size=16,
+        model="transformer",
+        vocab_size=64,
+        seq_len=16,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=32,
+        client_num_in_total=1,
+        client_num_per_round=1,
+        comm_round=1,
+        epochs=2,
+        batch_size=8,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        run_id="distributed_test",
+    )
+    base.update(kw)
+    return args_factory(**base)
+
+
+def _run(args_factory, **kw):
+    args = fedml_tpu.init(_args(args_factory, **kw))
+    ds = data.load(args)
+    model = models.create(args, ds.class_num)
+    trainer = DistributedTrainer(args, None, ds, model)
+    stats = trainer.run()
+    return trainer, stats
+
+
+@pytest.mark.smoke
+class TestMeshResolution:
+    def test_default_is_all_dp(self, args_factory):
+        mesh = _resolve_mesh(_args(args_factory))
+        assert dict(mesh.shape) == {"dp": len(jax.devices())}
+
+    def test_unknown_axis_rejected(self, args_factory):
+        with pytest.raises(ValueError, match="unknown"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"zz": 8}))
+
+    def test_exclusive_axes_rejected(self, args_factory):
+        with pytest.raises(ValueError, match="exclusive"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"pp": 4, "dp": 2}))
+        with pytest.raises(ValueError, match="exclusive"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"sp": 4, "tp": 2}))
+
+    def test_too_many_devices_rejected(self, args_factory):
+        with pytest.raises(ValueError, match="devices"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"dp": 4096}))
+
+
+class TestModes:
+    def test_dp_matches_single_device(self, args_factory):
+        _, single = _run(args_factory, mesh_shape={"dp": 1})
+        _, dp8 = _run(args_factory, mesh_shape={"dp": 8})
+        # SPMD is semantics-preserving but not bitwise (sharded matmul
+        # reduction order differs); over 2 epochs of steps the drift
+        # compounds — trajectory tolerance, same as the other modes
+        np.testing.assert_allclose(
+            dp8["train_loss"], single["train_loss"], rtol=2e-2
+        )
+        np.testing.assert_allclose(
+            dp8["test_loss"], single["test_loss"], rtol=2e-2
+        )
+
+    def test_dp_tp_ep_moe(self, args_factory):
+        _, single = _run(
+            args_factory, model="moe_transformer", num_experts=4,
+            mesh_shape={"dp": 1},
+        )
+        trainer, sharded = _run(
+            args_factory, model="moe_transformer", num_experts=4,
+            mesh_shape={"dp": 2, "tp": 2, "ep": 2},
+        )
+        assert trainer.mode == "sharded"
+        # expert stacks genuinely sharded
+        wi = trainer.params["Block_1"]["SwitchFFN_0"]["wi"]
+        assert wi.addressable_shards[0].data.shape[0] == wi.shape[0] // 2
+        # trajectory comparison: hundreds of optimizer steps compound
+        # fp reassociation from the tp/ep reduction orders — exact
+        # single-step equivalence is tested in test_moe/test_tensor_parallel
+        np.testing.assert_allclose(
+            sharded["train_loss"], single["train_loss"], rtol=2e-2
+        )
+
+    def test_sequence_parallel_ring(self, args_factory):
+        _, dense = _run(args_factory, mesh_shape={"dp": 1})
+        trainer, sp = _run(args_factory, mesh_shape={"sp": 8})
+        assert trainer.mode == "sequence"
+        # ring attention is exact up to fp reassociation; over a full
+        # training trajectory the drift compounds (exact single-step
+        # equivalence lives in test_longcontext)
+        np.testing.assert_allclose(
+            sp["train_loss"], dense["train_loss"], rtol=5e-2
+        )
+        np.testing.assert_allclose(sp["test_acc"], dense["test_acc"], atol=0.05)
+
+    def test_pipeline(self, args_factory):
+        _, seq = _run(args_factory, num_layers=4, mesh_shape={"dp": 1})
+        trainer, pp = _run(args_factory, num_layers=4, mesh_shape={"pp": 4})
+        assert trainer.mode == "pipeline"
+        # trajectory tolerance (loose: ~16 sgd steps at lr .1 amplify
+        # fp reassociation chaotically); exact forward/grad equivalence
+        # is test_pipeline's department. Both must have actually
+        # learned from the ~4.5 random-init loss.
+        np.testing.assert_allclose(pp["train_loss"], seq["train_loss"], rtol=0.15)
+        assert pp["train_loss"] < 1.5 and seq["train_loss"] < 1.5
+
+    def test_pipeline_layer_mismatch_rejected(self, args_factory):
+        with pytest.raises(ValueError, match="num_layers"):
+            _run(args_factory, num_layers=3, mesh_shape={"pp": 4})
+
+    def test_sp_needs_pluggable_attention(self, args_factory):
+        with pytest.raises(ValueError, match="attention"):
+            _run(
+                args_factory, model="rnn", dataset="shakespeare",
+                mesh_shape={"sp": 8},
+            )
+
+    def test_bf16(self, args_factory):
+        _, stats = _run(args_factory, mesh_shape={"dp": 8}, dtype="bfloat16")
+        assert np.isfinite(stats["train_loss"])
+        assert stats["tokens_per_sec"] > 0
+
+
+class TestOneLine:
+    def test_run_distributed_entry(self, args_factory, monkeypatch):
+        args = _args(args_factory, mesh_shape={"dp": 2})
+        stats = fedml_tpu.run_distributed(args)
+        assert "train_loss" in stats and "test_acc" in stats
