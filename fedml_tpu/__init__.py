@@ -139,7 +139,10 @@ def run_cross_silo_server(args: Optional[Arguments] = None, server_aggregator=No
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
     server = Server(args, dev, dataset, model, server_aggregator=server_aggregator)
-    return server.run()
+    from .core.tracking import device_trace
+
+    with device_trace(args):
+        return server.run()
 
 
 def run_cross_silo_client(args: Optional[Arguments] = None, client_trainer=None):
@@ -154,7 +157,10 @@ def run_cross_silo_client(args: Optional[Arguments] = None, client_trainer=None)
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
     client = Client(args, dev, dataset, model, client_trainer=client_trainer)
-    return client.run()
+    from .core.tracking import device_trace
+
+    with device_trace(args):
+        return client.run()
 
 
 def run_hierarchical_cross_silo_server(
@@ -182,7 +188,10 @@ def run_hierarchical_cross_silo_client(
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
     client = HierarchicalClient(args, dev, dataset, model, client_trainer=client_trainer)
-    return client.run()
+    from .core.tracking import device_trace
+
+    with device_trace(args):
+        return client.run()
 
 
 def run_edge_server(args: Optional[Arguments] = None):
@@ -199,4 +208,7 @@ def run_edge_server(args: Optional[Arguments] = None):
     dataset = data.load(args)
     model = models.create(args, dataset.class_num)
     server = ServerEdge(args, dev, dataset, model)
-    return server.run()
+    from .core.tracking import device_trace
+
+    with device_trace(args):
+        return server.run()

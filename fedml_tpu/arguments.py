@@ -110,9 +110,13 @@ _DEFAULTS: Dict[str, Any] = {
     # forward/backward matmuls in the MXU's native format with f32
     # master weights, optimizer state, and loss reductions
     "dtype": "float32",
-    # distributed platform (distributed.py): mesh axes -> sizes, e.g.
-    # {dp: 2, tp: 2, ep: 2} or {sp: 8} or {pp: 8}; None = all-dp
+    # mesh axes -> sizes. Scenario-specific vocabulary: the distributed
+    # platform (distributed.py) takes {dp/tp/ep} | {sp} | {pp}; the
+    # MESH simulation backend (simulation/simulator.py) takes
+    # {clients, data}. None = scenario default (all devices, one axis)
     "mesh_shape": None,
+    # capture an XLA device trace (tensorboard/perfetto) for the run
+    "profile_dir": None,
     "sp_strategy": "ring",  # or "ulysses"
     "pp_microbatches": 0,  # 0 = auto (2 x pipeline stages)
 }

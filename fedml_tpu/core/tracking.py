@@ -14,6 +14,13 @@ interfaces with no platform dependency:
 - ``RunLogger`` ~ ``MLOpsRuntimeLog`` (mlops_runtime_log.py:12-221):
   per-run log files with the chunked-upload seam kept as an interface
   (the reference uploads 100-line chunks to open.fedml.ai).
+
+Beyond the reference (SURVEY.md §5: "No torch-profiler integration"):
+spans also open a ``jax.profiler.TraceAnnotation`` so they appear as
+named regions in an XLA device trace, and ``device_trace(args)``
+captures a full trace (tensorboard/perfetto ``.xplane.pb``) for any
+run that sets ``args.profile_dir`` — the knob works identically on CPU
+and TPU.
 """
 
 from __future__ import annotations
@@ -78,13 +85,50 @@ class ProfilerEvent:
 class _Span:
     def __init__(self, ev: ProfilerEvent, name: str) -> None:
         self.ev, self.name = ev, name
+        self._annotation = None
 
     def __enter__(self):
         self.ev.log_event_started(self.name)
+        # named region in any active XLA device trace (no-op otherwise)
+        import jax.profiler
+
+        self._annotation = jax.profiler.TraceAnnotation(self.name)
+        self._annotation.__enter__()
         return self
 
     def __exit__(self, *exc):
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+            self._annotation = None
         self.ev.log_event_ended(self.name)
+        return False
+
+
+class device_trace:
+    """Capture an XLA device trace for a whole run when
+    ``args.profile_dir`` is set; inert otherwise. View with
+    ``tensorboard --logdir <profile_dir>`` or perfetto."""
+
+    def __init__(self, args=None) -> None:
+        self.logdir = getattr(args, "profile_dir", None) if args else None
+        self._active = False
+
+    def __enter__(self):
+        if self.logdir:
+            import jax.profiler
+
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            logging.info("device trace capturing to %s", self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            self._active = False
         return False
 
 

@@ -311,7 +311,9 @@ class DistributedTrainer:
         epochs = int(getattr(args, "epochs", 1))
         stats: Dict[str, float] = {}
         eval_every = int(getattr(args, "frequency_of_the_test", 1) or 1)
-        with self.mesh:
+        from .core.tracking import device_trace
+
+        with device_trace(args), self.mesh:
             for ep in range(epochs):
                 t0 = time.perf_counter()
                 self.params, self.opt_state, sums = self._epoch(

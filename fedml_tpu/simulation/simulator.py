@@ -57,6 +57,7 @@ class SimulatorSingleProcess:
     def __init__(
         self, args, device, dataset, model, client_trainer=None, server_aggregator=None
     ) -> None:
+        self.args = args
         cls = _select_algorithm(args)
         self.fl_trainer = cls(
             args,
@@ -67,7 +68,10 @@ class SimulatorSingleProcess:
         )
 
     def run(self):
-        return self.fl_trainer.train()
+        from ..core.tracking import device_trace
+
+        with device_trace(self.args):
+            return self.fl_trainer.train()
 
 
 class SimulatorMesh:
@@ -83,6 +87,7 @@ class SimulatorMesh:
         client_trainer=None,
         server_aggregator=None,
     ) -> None:
+        self.args = args
         self.mesh = mesh if mesh is not None else build_mesh(
             mesh_shape=getattr(args, "mesh_shape", None)
         )
@@ -124,4 +129,7 @@ class SimulatorMesh:
         )
 
     def run(self):
-        return self.fl_trainer.train()
+        from ..core.tracking import device_trace
+
+        with device_trace(self.args):
+            return self.fl_trainer.train()
