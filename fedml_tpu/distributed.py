@@ -103,6 +103,51 @@ class DistributedTrainer:
         init_rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
         builder = getattr(self, f"_build_{self.mode}")
         builder(init_rng)
+        # checkpoint/resume (core/checkpoint.py): save {params,
+        # opt_state, epoch}; a restarted process resumes mid-training
+        # with the restored leaves placed back onto this mode's
+        # shardings (the checkpoint itself is host arrays)
+        self._ckpt = None
+        self._start_epoch = 0
+        ckpt_dir = getattr(args, "checkpoint_dir", None)
+        if ckpt_dir:
+            from .core.checkpoint import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer(ckpt_dir)
+            self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
+            state = self._ckpt.restore()
+            if state is not None:
+                from flax.serialization import from_state_dict
+
+                self._start_epoch = int(state["epoch"]) + 1
+
+                def put_tree(cur_tree, new_tree):
+                    # name-based pairing (same pattern as fedavg_api's
+                    # _maybe_restore): orbax restores namedtuple optax
+                    # states as dicts whose flatten order can differ
+                    # from field order — positional zip would silently
+                    # swap same-shaped leaves (adam's mu/nu)
+                    restored = from_state_dict(cur_tree, new_tree)
+
+                    def put(c, n):
+                        # mesh-placed leaves keep their layout; leaves
+                        # optax created fresh (adam's scalar count has
+                        # a single-device sharding) go in replicated —
+                        # committing them to one device would conflict
+                        # with the mesh-sharded params under jit
+                        s = c.sharding if isinstance(
+                            c.sharding, NamedSharding
+                        ) else NamedSharding(self.mesh, P())
+                        return jax.device_put(jnp.asarray(n), s)
+
+                    return jax.tree.map(put, cur_tree, restored)
+
+                self.params = put_tree(self.params, state["params"])
+                self.opt_state = put_tree(self.opt_state, state["opt_state"])
+                logging.info(
+                    "distributed trainer resumed at epoch %d from %s",
+                    self._start_epoch, ckpt_dir,
+                )
 
     # -- shared pieces -------------------------------------------------
     def _loss(self, logits, y, mask):
@@ -313,30 +358,60 @@ class DistributedTrainer:
         eval_every = int(getattr(args, "frequency_of_the_test", 1) or 1)
         from .core.tracking import device_trace
 
-        with device_trace(args), self.mesh:
-            for ep in range(epochs):
-                t0 = time.perf_counter()
-                self.params, self.opt_state, sums = self._epoch(
-                    self.params, self.opt_state, train
+        try:
+            if self._start_epoch > 0 and self._start_epoch >= epochs:
+                # resumed from a checkpoint taken at/after the final
+                # epoch: nothing left to train, produce the terminal eval
+                logging.info(
+                    "resumed at epoch %d >= epochs %d; evaluating only",
+                    self._start_epoch, epochs,
                 )
-                jax.block_until_ready(jax.tree.leaves(self.params)[0])
-                dt = time.perf_counter() - t0
-                train_m = self.model.metrics_from_sums(
-                    jax.tree.map(np.asarray, sums)
-                )
-                stats = {
-                    "epoch": ep,
-                    "train_loss": train_m["loss"],
-                    "train_acc": train_m["acc"],
-                    "epoch_time_s": dt,
-                    "tokens_per_sec": train_m["count"] / max(dt, 1e-9),
-                }
-                if (ep + 1) % eval_every == 0 or ep == epochs - 1:
-                    stats.update(self._evaluate(test))
+                with self.mesh:
+                    stats = {"epoch": epochs - 1, **self._evaluate(test)}
                 self.metrics_reporter.report(
                     {"kind": "distributed_train", **stats}
                 )
-                logging.info("distributed epoch %d: %s", ep, stats)
+                return stats
+            with device_trace(args), self.mesh:
+                for ep in range(self._start_epoch, epochs):
+                    t0 = time.perf_counter()
+                    self.params, self.opt_state, sums = self._epoch(
+                        self.params, self.opt_state, train
+                    )
+                    jax.block_until_ready(jax.tree.leaves(self.params)[0])
+                    dt = time.perf_counter() - t0
+                    train_m = self.model.metrics_from_sums(
+                        jax.tree.map(np.asarray, sums)
+                    )
+                    stats = {
+                        "epoch": ep,
+                        "train_loss": train_m["loss"],
+                        "train_acc": train_m["acc"],
+                        "epoch_time_s": dt,
+                        "tokens_per_sec": train_m["count"] / max(dt, 1e-9),
+                    }
+                    if (ep + 1) % eval_every == 0 or ep == epochs - 1:
+                        stats.update(self._evaluate(test))
+                    self.metrics_reporter.report(
+                        {"kind": "distributed_train", **stats}
+                    )
+                    logging.info("distributed epoch %d: %s", ep, stats)
+                    if self._ckpt and (
+                        (ep + 1) % self._ckpt_freq == 0 or ep == epochs - 1
+                    ):
+                        from flax.serialization import to_state_dict
+
+                        self._ckpt.save(
+                            ep,
+                            {
+                                "params": self.params,
+                                "opt_state": to_state_dict(self.opt_state),
+                                "epoch": ep,
+                            },
+                        )
+        finally:
+            if self._ckpt is not None:
+                self._ckpt.close()
         return stats
 
     def _evaluate(self, test) -> Dict[str, float]:

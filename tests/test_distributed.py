@@ -148,6 +148,58 @@ class TestModes:
         assert stats["tokens_per_sec"] > 0
 
 
+class TestCheckpointResume:
+    def test_resume_matches_uninterrupted(self, args_factory, tmp_path):
+        """Train 2 epochs with checkpoints, 'crash', construct a fresh
+        trainer pointed at the same dir with epochs=4: final loss must
+        match an uninterrupted 4-epoch run (same data order, no
+        shuffle -> identical trajectory)."""
+        ckpt = str(tmp_path / "ckpt")
+        _, full = _run(args_factory, epochs=4, mesh_shape={"dp": 8})
+        _run(
+            args_factory, epochs=2, mesh_shape={"dp": 8},
+            checkpoint_dir=ckpt, checkpoint_freq=1,
+        )
+        _, resumed = _run(
+            args_factory, epochs=4, mesh_shape={"dp": 8},
+            checkpoint_dir=ckpt, checkpoint_freq=1,
+        )
+        assert resumed["epoch"] == 3
+        np.testing.assert_allclose(
+            resumed["train_loss"], full["train_loss"], rtol=1e-5
+        )
+
+    def test_resume_with_stateful_optimizer(self, args_factory, tmp_path):
+        """Adam's mu/nu are identically shaped — a positional restore
+        would swap them silently; the name-based restore must not."""
+        kw = dict(
+            mesh_shape={"dp": 8}, client_optimizer="adam",
+            learning_rate=0.01,
+        )
+        ckpt = str(tmp_path / "ckpt")
+        _, full = _run(args_factory, epochs=4, **kw)
+        _run(args_factory, epochs=2, checkpoint_dir=ckpt,
+             checkpoint_freq=1, **kw)
+        _, resumed = _run(args_factory, epochs=4, checkpoint_dir=ckpt,
+                          checkpoint_freq=1, **kw)
+        np.testing.assert_allclose(
+            resumed["train_loss"], full["train_loss"], rtol=1e-5
+        )
+
+    def test_completed_run_does_not_retrain(self, args_factory, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        _run(
+            args_factory, epochs=2, mesh_shape={"dp": 8},
+            checkpoint_dir=ckpt, checkpoint_freq=1,
+        )
+        _, again = _run(
+            args_factory, epochs=2, mesh_shape={"dp": 8},
+            checkpoint_dir=ckpt, checkpoint_freq=1,
+        )
+        assert "train_loss" not in again  # eval-only terminal path
+        assert "test_acc" in again
+
+
 class TestOneLine:
     def test_run_distributed_entry(self, args_factory, monkeypatch):
         args = _args(args_factory, mesh_shape={"dp": 2})
