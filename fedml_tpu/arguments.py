@@ -118,6 +118,9 @@ _DEFAULTS: Dict[str, Any] = {
     # capture an XLA device trace (tensorboard/perfetto) for the run
     "profile_dir": None,
     "sp_strategy": "ring",  # or "ulysses"
+    # rematerialize transformer blocks (jax.checkpoint): trade FLOPs
+    # for HBM — recompute block activations in the backward pass
+    "remat": False,
     "pp_microbatches": 0,  # 0 = auto (2 x pipeline stages)
 }
 

@@ -329,10 +329,15 @@ class DistributedTrainer:
         )
         x = x + pos[None]
 
-        def stage_fn(stage_params, h):
-            def one_block(h, bp):
-                return block.apply({"params": bp}, h), None
+        def one_block(h, bp):
+            return block.apply({"params": bp}, h), None
 
+        if m.remat:
+            # honor the model's remat flag in the pipelined stack too:
+            # recompute each block's activations in the backward pass
+            one_block = jax.checkpoint(one_block)
+
+        def stage_fn(stage_params, h):
             h, _ = jax.lax.scan(one_block, h, stage_params)
             return h
 

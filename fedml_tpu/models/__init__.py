@@ -189,6 +189,7 @@ def create(args, output_dim: int) -> FedModel:
                 embed_dim=int(getattr(args, "embed_dim", 128)),
                 max_len=max(seq_len, int(getattr(args, "max_len", 512))),
                 attention=getattr(args, "attention_impl", "full"),
+                remat=bool(getattr(args, "remat", False)),
             ),
             task="nwp",
             example_shape=(seq_len,),
@@ -211,6 +212,7 @@ def create(args, output_dim: int) -> FedModel:
                 capacity_factor=float(getattr(args, "capacity_factor", 1.25)),
                 moe_every=int(getattr(args, "moe_every", 2)),
                 attention=getattr(args, "attention_impl", "full"),
+                remat=bool(getattr(args, "remat", False)),
             ),
             task="nwp",
             example_shape=(seq_len,),

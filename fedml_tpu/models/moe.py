@@ -33,7 +33,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .transformer import Block, TransformerLM
+from .transformer import TransformerLM
 
 
 class SwitchFFN(nn.Module):
@@ -95,9 +95,12 @@ class MoETransformerLM(TransformerLM):
     def make_block(self, i: int, attn: Callable) -> nn.Module:
         if (i + 1) % self.moe_every != 0:
             return super().make_block(i, attn)
-        ffn = functools.partial(
-            SwitchFFN,
-            num_experts=self.num_experts,
-            capacity_factor=self.capacity_factor,
+        return super().make_block(
+            i,
+            attn,
+            ffn=functools.partial(
+                SwitchFFN,
+                num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor,
+            ),
         )
-        return Block(num_heads=self.num_heads, attn_fn=attn, ffn=ffn)

@@ -90,11 +90,25 @@ class TransformerLM(nn.Module):
     max_len: int = 512
     attention: str = "full"
     attn_fn: Optional[Callable] = None
+    # rematerialization (jax.checkpoint): drop each block's activations
+    # on the forward pass and recompute them in the backward — the
+    # standard HBM-for-FLOPs trade for long sequences / deep stacks.
+    # Param names are unchanged (flax's lifted remat preserves scopes),
+    # so checkpoints and tp/ep layout rules apply identically.
+    remat: bool = False
 
-    def make_block(self, i: int, attn: Callable) -> nn.Module:
+    def make_block(
+        self, i: int, attn: Callable, ffn: Optional[Callable] = None
+    ) -> nn.Module:
         """Layer ``i``'s block; subclasses override (MoETransformerLM
-        swaps in routed FFNs on a stride)."""
-        return Block(num_heads=self.num_heads, attn_fn=attn)
+        swaps in routed FFNs on a stride) and pass ``ffn`` back here so
+        remat wrapping and naming have one implementation. The explicit
+        name matters: nn.remat(Block) would auto-name the module
+        CheckpointBlock_i, breaking param-tree compatibility."""
+        cls = nn.remat(Block) if self.remat else Block
+        return cls(
+            num_heads=self.num_heads, attn_fn=attn, ffn=ffn, name=f"Block_{i}"
+        )
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
