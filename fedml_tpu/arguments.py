@@ -122,6 +122,9 @@ _DEFAULTS: Dict[str, Any] = {
     # for HBM — recompute block activations in the backward pass
     "remat": False,
     "pp_microbatches": 0,  # 0 = auto (2 x pipeline stages)
+    # weight of the Switch MoE load-balancing aux loss in the
+    # distributed trainer's objective (0 disables)
+    "moe_aux_weight": 0.01,
 }
 
 _SECTIONS = (

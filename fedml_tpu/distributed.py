@@ -154,10 +154,34 @@ class DistributedTrainer:
         loss, metrics = self.model.loss_fn(logits.astype(jnp.float32), y, mask)
         return loss, metrics
 
+    def _apply_with_aux(self, params, x):
+        """Forward that also surfaces the Switch load-balancing aux
+        loss (models/moe.py sows it): returns (logits, mean aux). A
+        model with no routed layers yields aux = 0 — the mutable apply
+        costs nothing there."""
+        logits, mods = self.model.module.apply(
+            {"params": params}, x, mutable=["intermediates"]
+        )
+        auxes = [
+            leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                mods.get("intermediates", {})
+            )[0]
+            if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)
+        ]
+        aux = sum(auxes) / len(auxes) if auxes else jnp.float32(0.0)
+        return logits, aux
+
     def _epoch_scanner(self, apply_fn):
-        """(params, opt_state, batches) -> scan of optimizer steps."""
+        """(params, opt_state, batches) -> scan of optimizer steps.
+        ``apply_fn(p, x) -> (logits, aux)``; the Switch aux loss rides
+        into the optimized objective with weight ``moe_aux_weight``
+        (the reported per-batch loss stays the pure cross-entropy)."""
         optimizer = self.optimizer
         dtype = self.compute_dtype
+        # default lives in arguments._DEFAULTS; fall back to disabled
+        # for args objects built outside the Arguments layer
+        aux_w = float(getattr(self.args, "moe_aux_weight", 0.0) or 0.0)
 
         def step(carry, batch):
             params, opt_state = carry
@@ -169,7 +193,9 @@ class DistributedTrainer:
                     x_ = _cast_floats(x, dtype)
                 else:
                     x_ = x
-                return self._loss(apply_fn(p, x_), y, m)
+                logits, aux = apply_fn(p, x_)
+                loss, metrics = self._loss(logits, y, m)
+                return loss + aux_w * aux.astype(jnp.float32), metrics
 
             (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -204,7 +230,7 @@ class DistributedTrainer:
         self._place_data = lambda b: jax.device_put(
             b, NamedSharding(self.mesh, batch_spec)
         )
-        self._epoch = jax.jit(self._epoch_scanner(self.model.apply))
+        self._epoch = jax.jit(self._epoch_scanner(self._apply_with_aux))
         self._eval_apply = self.model.apply
 
     # -- sequence: sp (ring / Ulysses attention) ----------------------
@@ -252,7 +278,7 @@ class DistributedTrainer:
             )
 
         self._place_data = place
-        self._epoch = jax.jit(self._epoch_scanner(self.model.apply))
+        self._epoch = jax.jit(self._epoch_scanner(self._apply_with_aux))
         self._eval_apply = self.model.apply
 
     # -- pipeline: pp (GPipe over the block stack) --------------------
@@ -301,7 +327,12 @@ class DistributedTrainer:
         self._place_data = lambda b: jax.device_put(
             b, NamedSharding(self.mesh, P())
         )
-        self._epoch = jax.jit(self._epoch_scanner(self._pp_apply))
+        self._epoch = jax.jit(
+            self._epoch_scanner(
+                # pp rejects MoE modules, so there is no aux loss here
+                lambda p, x: (self._pp_apply(p, x), jnp.float32(0.0))
+            )
+        )
         self._eval_apply = self._pp_apply
 
     def _pp_apply(self, params, tokens):

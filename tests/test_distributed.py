@@ -176,6 +176,16 @@ class TestModes:
                 mesh_shape={"sp": 8},
             )
 
+    def test_moe_aux_loss_shapes_training(self, args_factory):
+        """The Switch aux loss must actually reach the objective: the
+        same MoE run with aux weight 0 vs 1.0 lands on different
+        params (a silently-dropped aux would make them identical)."""
+        kw = dict(model="moe_transformer", num_experts=4,
+                  mesh_shape={"dp": 1}, epochs=1)
+        _, off = _run(args_factory, moe_aux_weight=0.0, **kw)
+        _, on = _run(args_factory, moe_aux_weight=1.0, **kw)
+        assert abs(on["train_loss"] - off["train_loss"]) > 1e-6
+
     def test_bf16(self, args_factory):
         _, stats = _run(args_factory, mesh_shape={"dp": 8}, dtype="bfloat16")
         assert np.isfinite(stats["train_loss"])
