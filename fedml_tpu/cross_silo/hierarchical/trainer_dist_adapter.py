@@ -122,19 +122,13 @@ class TrainerDistAdapter:
         self.client_index = int(client_index)
 
     def _put(self, a, sharding):
-        """Host array -> global device array on the silo mesh. Under a
-        single controller ``device_put`` suffices; under multi-controller
-        every process holds the full host copy (same seed -> same data,
-        same params off the control fabric) and
-        ``make_array_from_callback`` hands each process exactly the
-        shards it is responsible for — the assembly step the reference
-        gets from DDP scattering per-rank loaders."""
-        if not self.pg.multi_controller:
-            return jax.device_put(a, sharding)
-        host = np.asarray(a)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx, _h=host: _h[idx]
-        )
+        """Host array -> global device array on the silo mesh — the
+        shared single/multi-controller placement seam
+        (``parallel.mesh._put``): the assembly step the reference gets
+        from DDP scattering per-rank loaders."""
+        from ...parallel.mesh import _put
+
+        return _put(a, sharding, self.pg.multi_controller)
 
     def _silo_batch(self) -> Batches:
         i = self.client_index

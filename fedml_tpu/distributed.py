@@ -78,6 +78,15 @@ def _resolve_mesh(args) -> Mesh:
     n = int(np.prod(list(shape.values())))
     if n > len(devices):
         raise ValueError(f"mesh_shape {shape} needs {n} devices, have {len(devices)}")
+    if jax.process_count() > 1 and n != len(devices):
+        # a device subset could exclude every addressable device of
+        # some process, which then holds no shard of anything — refuse
+        # loudly (same policy as the multi-controller checkpoint guard)
+        raise ValueError(
+            f"multi-controller run ({jax.process_count()} processes): "
+            f"mesh_shape {shape} must span all {len(devices)} global "
+            f"devices, not {n}"
+        )
     return build_mesh(devices=devices[:n], mesh_shape=shape)
 
 
@@ -111,6 +120,15 @@ class DistributedTrainer:
         self._start_epoch = 0
         ckpt_dir = getattr(args, "checkpoint_dir", None)
         if ckpt_dir:
+            from .parallel.mesh import is_multi_controller
+
+            if is_multi_controller(self.mesh):
+                # np.asarray on non-fully-addressable arrays would
+                # crash mid-save; refuse up front instead
+                raise ValueError(
+                    "checkpoint_dir is not supported in multi-controller "
+                    "runs yet — each process only holds its shards"
+                )
             from .core.checkpoint import RoundCheckpointer
 
             self._ckpt = RoundCheckpointer(ckpt_dir)
@@ -226,9 +244,11 @@ class DistributedTrainer:
         params = self.model.init(init_rng)
         self.params = shard_params_tp_ep(params, self.mesh)
         self.opt_state = self.optimizer.init(self.params)
+        from .parallel.mesh import place_global
+
         batch_spec = P(None, "dp") if "dp" in self.mesh.axis_names else P()
-        self._place_data = lambda b: jax.device_put(
-            b, NamedSharding(self.mesh, batch_spec)
+        self._place_data = lambda b: jax.tree.map(
+            lambda a: place_global(a, NamedSharding(self.mesh, batch_spec)), b
         )
         self._epoch = jax.jit(self._epoch_scanner(self._apply_with_aux))
         self._eval_apply = self.model.apply
@@ -266,9 +286,11 @@ class DistributedTrainer:
         # x/y [nb, bs, T]: token axis over sp; the per-example mask
         # [nb, bs] (and any rank<3 leaf) stays replicated — the
         # attention shard_map pins non-sequence axes anyway
+        from .parallel.mesh import place_global
+
         def place(b):
             return jax.tree.map(
-                lambda a: jax.device_put(
+                lambda a: place_global(
                     a,
                     NamedSharding(
                         self.mesh, P(None, None, "sp") if a.ndim >= 3 else P()
@@ -324,8 +346,10 @@ class DistributedTrainer:
             )
         self.params = {"outer": outer, "stages": stages}
         self.opt_state = self.optimizer.init(self.params)
-        self._place_data = lambda b: jax.device_put(
-            b, NamedSharding(self.mesh, P())
+        from .parallel.mesh import place_global
+
+        self._place_data = lambda b: jax.tree.map(
+            lambda a: place_global(a, NamedSharding(self.mesh, P())), b
         )
         self._epoch = jax.jit(
             self._epoch_scanner(

@@ -49,11 +49,13 @@ def shard_params_ep(params: Any, mesh: Mesh, axis: str = "ep") -> Any:
         return replicate(params, mesh)
     ep = mesh.shape[axis]
 
+    from .mesh import place_global
+
     def place(path, leaf):
         spec = _spec_for(path, leaf, axis)
         if spec and spec[0] == axis and leaf.shape[0] % ep != 0:
             spec = P()
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return place_global(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)
 
@@ -81,6 +83,8 @@ def shard_params_tp_ep(
     layout; any dim that doesn't divide its mesh axis falls back to
     replicated for that leaf."""
 
+    from .mesh import place_global
+
     def place(leaf, spec):
         for dim, name in enumerate(spec):
             if name is not None and (
@@ -89,6 +93,6 @@ def shard_params_tp_ep(
             ):
                 spec = P()
                 break
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return place_global(leaf, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, params, tp_ep_specs(params, tp_axis, ep_axis))

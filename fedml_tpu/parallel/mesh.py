@@ -97,6 +97,15 @@ def _put(a: Any, sharding: NamedSharding, multi: bool):
     )
 
 
+def place_global(a: Any, sharding: NamedSharding) -> jax.Array:
+    """Host/device value -> global array with ``sharding``, working on
+    a single controller (plain device_put) AND across a
+    multi-controller process group (each process materializes only its
+    addressable shards from its own full host copy — callers guarantee
+    every process holds the same value, e.g. same-seed data/init)."""
+    return _put(a, sharding, is_multi_controller(sharding.mesh))
+
+
 def shard_federation(
     packed: Batches, num_samples, mesh: Mesh
 ) -> Tuple[Batches, jax.Array]:

@@ -88,13 +88,15 @@ def shard_params_tp(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
         return replicate(params, mesh)
     tp = mesh.shape[axis]
 
+    from .mesh import place_global
+
     def place(path, leaf):
         spec = _spec_for(path, leaf, axis)
         for dim, name in enumerate(spec):
             if name == axis and leaf.shape[dim] % tp != 0:
                 spec = P()
                 break
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return place_global(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)
 
@@ -103,8 +105,10 @@ def shard_batch_dp(batch: Any, mesh: Mesh, axis: str = "dp") -> Any:
     """Shard the leading (batch) axis of every leaf over ``axis``."""
     if axis not in mesh.axis_names:
         return batch
+    from .mesh import place_global
+
     return jax.tree.map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), batch
+        lambda a: place_global(a, NamedSharding(mesh, P(axis))), batch
     )
 
 

@@ -1,0 +1,84 @@
+"""One host process of a multi-controller DISTRIBUTED (mesh LM) run.
+
+The distributed platform's multi-host seam: the dp mesh spans several
+OS processes via ``jax.distributed``; every process runs the same
+jitted epoch and XLA executes it as one SPMD computation with
+cross-process collectives. Spawned by
+``tests/test_multiprocess_distributed.py``.
+"""
+
+import argparse
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--proc_rank", type=int, required=True)
+    p.add_argument("--n_proc", type=int, required=True)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--out", default="")
+    ns = p.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=ns.coordinator,
+        num_processes=ns.n_proc,
+        process_id=ns.proc_rank,
+    )
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == ns.n_proc
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data import load
+    from fedml_tpu.distributed import DistributedTrainer
+    from fedml_tpu.parallel.mesh import is_multi_controller
+
+    args = Arguments()
+    for k, v in dict(
+        training_type="distributed",
+        dataset="shakespeare",
+        synthetic_train_size=64,
+        synthetic_test_size=16,
+        model="transformer",
+        seq_len=16,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=32,
+        client_num_in_total=1,
+        client_num_per_round=1,
+        comm_round=1,
+        epochs=2,
+        batch_size=8,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        mesh_shape={"dp": 8},
+        run_id=f"dist_mp_{ns.proc_rank}",
+    ).items():
+        setattr(args, k, v)
+    args._validate()
+    args = fedml_tpu.init(args)
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    trainer = DistributedTrainer(args, None, dataset, model)
+    assert is_multi_controller(trainer.mesh)
+    stats = trainer.run()
+
+    if ns.proc_rank == 0 and ns.out:
+        # dp-only params are fully replicated -> addressable everywhere
+        flat = {
+            f"p{i}": np.asarray(x)
+            for i, x in enumerate(jax.tree.leaves(trainer.params))
+        }
+        flat["train_loss"] = np.float64(stats["train_loss"])
+        np.savez(ns.out, **flat)
+    print("DIST_WORKER_DONE", ns.proc_rank, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
