@@ -125,6 +125,10 @@ _DEFAULTS: Dict[str, Any] = {
     # weight of the Switch MoE load-balancing aux loss in the
     # distributed trainer's objective (0 disables)
     "moe_aux_weight": 0.01,
+    # gradient accumulation in the distributed trainer: chunk each
+    # batch into N grad passes before one update (HBM lever); exact
+    # (count-weighted) vs the unchunked masked-mean gradient
+    "grad_accum_steps": 1,
 }
 
 _SECTIONS = (

@@ -194,28 +194,77 @@ class DistributedTrainer:
         """(params, opt_state, batches) -> scan of optimizer steps.
         ``apply_fn(p, x) -> (logits, aux)``; the Switch aux loss rides
         into the optimized objective with weight ``moe_aux_weight``
-        (the reported per-batch loss stays the pure cross-entropy)."""
+        (the reported per-batch loss stays the pure cross-entropy).
+
+        ``grad_accum_steps > 1`` splits each batch into chunks whose
+        gradients accumulate (weighted by their masked token counts, so
+        the result is EXACTLY the full-batch masked-mean gradient)
+        before one optimizer update — the HBM lever when a batch's
+        activations don't fit. With MoE the router sees chunk-sized
+        token pools, so capacity granularity shrinks accordingly.
+        """
         optimizer = self.optimizer
         dtype = self.compute_dtype
-        # default lives in arguments._DEFAULTS; fall back to disabled
+        # defaults live in arguments._DEFAULTS; fall back to disabled
         # for args objects built outside the Arguments layer
         aux_w = float(getattr(self.args, "moe_aux_weight", 0.0) or 0.0)
+        accum = int(getattr(self.args, "grad_accum_steps", 1) or 1)
+        if accum < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+
+        def loss_fn(p, x, y, m):
+            if dtype is not None:
+                p = _cast_floats(p, dtype)
+                x = _cast_floats(x, dtype)
+            logits, aux = apply_fn(p, x)
+            loss, metrics = self._loss(logits, y, m)
+            return loss + aux_w * aux.astype(jnp.float32), metrics
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def batch_grads(params, x, y, m):
+            """(grads, metrics) for one batch, chunked when accum>1."""
+            if accum <= 1:
+                (_, metrics), grads = grad_fn(params, x, y, m)
+                return grads, metrics
+            if x.shape[0] % accum:
+                raise ValueError(
+                    f"grad_accum_steps={accum} must divide batch_size "
+                    f"{x.shape[0]}"
+                )
+
+            def split(a):
+                return a.reshape(accum, a.shape[0] // accum, *a.shape[1:])
+
+            def chunk(carry, ch):
+                gsum, lsum, csum, nsum = carry
+                cx, cy, cm = ch
+                (_, metrics), grads = grad_fn(params, cx, cy, cm)
+                w = metrics["count"]
+                gsum = jax.tree.map(lambda g_, gs: gs + g_ * w, grads, gsum)
+                return (
+                    gsum,
+                    lsum + metrics["loss"] * w,
+                    csum + metrics["correct"],
+                    nsum + w,
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (gsum, lsum, csum, nsum), _ = jax.lax.scan(
+                chunk,
+                (zeros, jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)),
+                (split(x), split(y), split(m)),
+            )
+            denom = jnp.maximum(nsum, 1.0)
+            grads = jax.tree.map(lambda gs: gs / denom, gsum)
+            return grads, {
+                "loss": lsum / denom, "correct": csum, "count": nsum,
+            }
 
         def step(carry, batch):
             params, opt_state = carry
             x, y, m = batch
-
-            def loss_fn(p):
-                if dtype is not None:
-                    p = _cast_floats(p, dtype)
-                    x_ = _cast_floats(x, dtype)
-                else:
-                    x_ = x
-                logits, aux = apply_fn(p, x_)
-                loss, metrics = self._loss(logits, y, m)
-                return loss + aux_w * aux.astype(jnp.float32), metrics
-
-            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads, metrics = batch_grads(params, x, y, m)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), metrics

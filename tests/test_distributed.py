@@ -176,6 +176,22 @@ class TestModes:
                 mesh_shape={"sp": 8},
             )
 
+    def test_grad_accumulation_matches_unchunked(self, args_factory):
+        """Count-weighted accumulation is the exact full-batch masked
+        mean — only fp reassociation separates the trajectories."""
+        _, whole = _run(args_factory, mesh_shape={"dp": 1}, epochs=1)
+        _, chunked = _run(
+            args_factory, mesh_shape={"dp": 1}, epochs=1, grad_accum_steps=4
+        )
+        np.testing.assert_allclose(
+            chunked["train_loss"], whole["train_loss"], rtol=1e-3
+        )
+
+    def test_grad_accumulation_divisibility(self, args_factory):
+        with pytest.raises(ValueError, match="grad_accum_steps"):
+            _run(args_factory, mesh_shape={"dp": 1}, epochs=1,
+                 grad_accum_steps=3)
+
     def test_moe_aux_loss_shapes_training(self, args_factory):
         """The Switch aux loss must actually reach the objective: the
         same MoE run with aux weight 0 vs 1.0 lands on different
