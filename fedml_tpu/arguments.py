@@ -129,6 +129,11 @@ _DEFAULTS: Dict[str, Any] = {
     # batch into N grad passes before one update (HBM lever); exact
     # (count-weighted) vs the unchunked masked-mean gradient
     "grad_accum_steps": 1,
+    # learning-rate schedule (core/optimizers.py): "constant" or
+    # "cosine" (decays over lr_total_steps, linear warmup_steps ramp)
+    "lr_schedule": "constant",
+    "lr_total_steps": 0,
+    "warmup_steps": 0,
 }
 
 _SECTIONS = (

@@ -24,12 +24,46 @@ _CLIENT_OPTS = {
 }
 
 
+def resolve_learning_rate(args):
+    """``args.learning_rate`` or an optax schedule over it.
+
+    ``lr_schedule: cosine`` decays to zero over ``lr_total_steps``
+    optimizer steps, with a linear ``warmup_steps`` ramp when set.
+    Steps count within ONE optimizer lifetime: the distributed trainer
+    holds one optimizer for the whole run, while FL local training
+    re-inits per round (a schedule there restarts every round — usually
+    you want it on the server/distributed side).
+    """
+    base = float(args.learning_rate)
+    name = str(getattr(args, "lr_schedule", "constant") or "constant").lower()
+    if name == "constant":
+        return base
+    if name != "cosine":
+        raise ValueError(
+            f"lr_schedule {name!r}: pick 'constant' or 'cosine'"
+        )
+    total = int(getattr(args, "lr_total_steps", 0) or 0)
+    if total <= 0:
+        raise ValueError("lr_schedule=cosine needs lr_total_steps > 0")
+    warm = int(getattr(args, "warmup_steps", 0) or 0)
+    if warm >= total:
+        raise ValueError(
+            f"warmup_steps ({warm}) must be < lr_total_steps ({total})"
+        )
+    if warm > 0:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=base,
+            warmup_steps=warm, decay_steps=total,
+        )
+    return optax.cosine_decay_schedule(base, decay_steps=total)
+
+
 def create_client_optimizer(args) -> optax.GradientTransformation:
     name = getattr(args, "client_optimizer", "sgd").lower()
     if name not in _CLIENT_OPTS:
         raise ValueError(f"unknown client_optimizer {name!r}")
     wd = float(getattr(args, "weight_decay", 0.0) or 0.0)
-    tx = _CLIENT_OPTS[name](float(args.learning_rate), args)
+    tx = _CLIENT_OPTS[name](resolve_learning_rate(args), args)
     if name == "sgd" and wd > 0.0:
         tx = optax.chain(optax.add_decayed_weights(wd), tx)
     return tx

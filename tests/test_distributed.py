@@ -47,13 +47,14 @@ def _args(args_factory, **kw):
 _DENSE_BASELINE = {}
 
 
-def _dense_baseline(args_factory):
-    """Memoized single-device trajectory shared by the sp oracles
+def _dense_baseline(args_factory, **kw):
+    """Memoized single-device trajectories shared across oracles
     (identical config -> identical stats; each run costs minutes)."""
-    if "stats" not in _DENSE_BASELINE:
-        _, stats = _run(args_factory, mesh_shape={"dp": 1})
-        _DENSE_BASELINE["stats"] = stats
-    return _DENSE_BASELINE["stats"]
+    key = tuple(sorted(kw.items()))
+    if key not in _DENSE_BASELINE:
+        _, stats = _run(args_factory, mesh_shape={"dp": 1}, **kw)
+        _DENSE_BASELINE[key] = stats
+    return _DENSE_BASELINE[key]
 
 
 def _run(args_factory, **kw):
@@ -179,7 +180,7 @@ class TestModes:
     def test_grad_accumulation_matches_unchunked(self, args_factory):
         """Count-weighted accumulation is the exact full-batch masked
         mean — only fp reassociation separates the trajectories."""
-        _, whole = _run(args_factory, mesh_shape={"dp": 1}, epochs=1)
+        whole = _dense_baseline(args_factory, epochs=1)
         _, chunked = _run(
             args_factory, mesh_shape={"dp": 1}, epochs=1, grad_accum_steps=4
         )
@@ -191,6 +192,29 @@ class TestModes:
         with pytest.raises(ValueError, match="grad_accum_steps"):
             _run(args_factory, mesh_shape={"dp": 1}, epochs=1,
                  grad_accum_steps=3)
+
+    def test_cosine_lr_schedule_shapes_training(self, args_factory):
+        """A decaying schedule must genuinely reach the optimizer."""
+        from fedml_tpu.core.optimizers import resolve_learning_rate
+
+        a = _args(args_factory, lr_schedule="cosine", lr_total_steps=16,
+                  warmup_steps=4)
+        sched = resolve_learning_rate(a)
+        assert callable(sched)
+        assert float(sched(0)) < 1e-6  # warmup starts at ~0
+        assert abs(float(sched(4)) - 0.1) < 1e-6  # peak at warmup end
+        assert float(sched(16)) < 1e-3  # decayed away
+        with pytest.raises(ValueError, match="lr_total_steps"):
+            resolve_learning_rate(_args(args_factory, lr_schedule="cosine"))
+        with pytest.raises(ValueError, match="lr_schedule"):
+            resolve_learning_rate(_args(args_factory, lr_schedule="bogus"))
+
+        const = _dense_baseline(args_factory, epochs=1)
+        _, cos = _run(
+            args_factory, mesh_shape={"dp": 1}, epochs=1,
+            lr_schedule="cosine", lr_total_steps=16, warmup_steps=4,
+        )
+        assert abs(cos["train_loss"] - const["train_loss"]) > 1e-6
 
     def test_moe_aux_loss_shapes_training(self, args_factory):
         """The Switch aux loss must actually reach the objective: the
