@@ -110,6 +110,8 @@ class DistributedTrainer:
 
         self.metrics_reporter = MetricsReporter(args)
         init_rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        # distinct stream for the per-epoch shuffle permutations
+        self._shuffle_key = jax.random.fold_in(init_rng, 0x51)
         builder = getattr(self, f"_build_{self.mode}")
         builder(init_rng)
         # checkpoint/resume (core/checkpoint.py): save {params,
@@ -269,7 +271,13 @@ class DistributedTrainer:
             params = optax.apply_updates(params, updates)
             return (params, opt_state), metrics
 
-        def epoch(params, opt_state, batches):
+        shuffle = bool(getattr(self.args, "shuffle", True))
+
+        def epoch(params, opt_state, batches, rng):
+            if shuffle:
+                from .core.local_trainer import _shuffle_batches
+
+                batches = _shuffle_batches(batches, rng)
             (params, opt_state), metrics = jax.lax.scan(
                 step, (params, opt_state), (batches.x, batches.y, batches.mask)
             )
@@ -484,8 +492,16 @@ class DistributedTrainer:
             with device_trace(args), self.mesh:
                 for ep in range(self._start_epoch, epochs):
                     t0 = time.perf_counter()
+                    # epoch-INDEXED stream (fold_in, not sequential
+                    # split): a resumed run replays exactly the
+                    # permutations the interrupted run would have used;
+                    # every process derives the same host value, so the
+                    # shuffle is multi-controller consistent
+                    ep_rng = np.asarray(
+                        jax.random.fold_in(self._shuffle_key, ep)
+                    )
                     self.params, self.opt_state, sums = self._epoch(
-                        self.params, self.opt_state, train
+                        self.params, self.opt_state, train, ep_rng
                     )
                     jax.block_until_ready(jax.tree.leaves(self.params)[0])
                     dt = time.perf_counter() - t0

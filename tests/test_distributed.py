@@ -216,6 +216,19 @@ class TestModes:
         )
         assert abs(cos["train_loss"] - const["train_loss"]) > 1e-6
 
+    def test_shuffle_changes_trajectory_deterministically(self, args_factory):
+        """args.shuffle reorders examples per epoch (epoch-indexed rng:
+        reruns and resumes replay identical permutations)."""
+        shuffled = _dense_baseline(args_factory, epochs=1)  # shuffle=True default
+        _, again = _run(args_factory, mesh_shape={"dp": 1}, epochs=1)
+        np.testing.assert_allclose(
+            again["train_loss"], shuffled["train_loss"], rtol=1e-6
+        )  # deterministic across reruns
+        _, ordered = _run(
+            args_factory, mesh_shape={"dp": 1}, epochs=1, shuffle=False
+        )
+        assert abs(ordered["train_loss"] - shuffled["train_loss"]) > 1e-6
+
     def test_moe_aux_loss_shapes_training(self, args_factory):
         """The Switch aux loss must actually reach the objective: the
         same MoE run with aux weight 0 vs 1.0 lands on different
