@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Fast gate: the smoke tier (<60s warm) — unit core, oracles, native
+# Fast gate: the smoke tier (~3 min warm) — unit core, oracles, native
 # runtime, transports, operator seam, data ingestion.
 set -e
 cd "$(dirname "$0")/.."
