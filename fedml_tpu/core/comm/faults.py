@@ -149,7 +149,9 @@ class FaultInjector(BaseCommunicationManager):
         self.inner.handle_receive_message()
 
     def stop_receive_message(self) -> None:
-        for t in self._timers:
+        # snapshot: firing timers remove themselves from self._timers,
+        # and mutating the list mid-iteration can skip a cancel
+        for t in list(self._timers):
             t.cancel()
         self.inner.stop_receive_message()
 

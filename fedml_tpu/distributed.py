@@ -374,7 +374,7 @@ class DistributedTrainer:
         S = self.mesh.shape["pp"]
         L = int(module.num_layers)
         if L % S:
-            raise ValueError(f"num_layers {L} must divide pp={S}")
+            raise ValueError(f"pp={S} must divide num_layers {L}")
         self._layers_per_stage = L // S
         self._pp_module = module
         params = self.model.init(

@@ -51,12 +51,17 @@ class SwitchFFN(nn.Module):
         cap = max(1, math.ceil(N / E * self.capacity_factor))
         xf = x.reshape(N, C)
 
-        # -- routing -------------------------------------------------
-        logits = nn.Dense(E, use_bias=False, name="router")(xf)
-        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        # -- routing (always f32: bf16 cumsum only represents integers
+        # exactly up to 256, so capacity positions past that would
+        # collide and silently corrupt dispatch — the Switch/T5X
+        # f32-router convention) ------------------------------------
+        logits = nn.Dense(E, use_bias=False, name="router")(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [N, E]
         gate = jnp.max(probs, axis=-1)           # [N]
         expert = jnp.argmax(probs, axis=-1)      # [N]
-        onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)  # [N, E]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
 
         # Switch aux loss: E * sum_e (dispatch fraction * mean prob)
         frac = jnp.mean(onehot, axis=0)
@@ -64,12 +69,18 @@ class SwitchFFN(nn.Module):
         self.sow("intermediates", "moe_aux_loss", E * jnp.sum(frac * mean_prob))
 
         # -- capacity + dispatch/combine tensors ---------------------
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E], f32
         keep = onehot * (pos < cap)                        # [N, E]
-        disp = keep[..., None] * jax.nn.one_hot(
-            pos.astype(jnp.int32), cap, dtype=x.dtype
+        disp_f32 = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), cap, dtype=jnp.float32
         )  # [N, E, cap]
-        combine = disp * gate[:, None, None]               # [N, E, cap]
+        # slot occupancy must be 0/1 — a bf16 cumsum would collide
+        # capacity positions past 256; tests assert on this seam
+        self.sow(
+            "intermediates", "moe_slot_occupancy", disp_f32.sum(axis=0)
+        )
+        disp = disp_f32.astype(x.dtype)
+        combine = disp * gate[:, None, None].astype(x.dtype)  # [N, E, cap]
 
         # -- expert computation (three batched matmuls) --------------
         wi = self.param("wi", nn.initializers.lecun_normal(), (E, C, H))

@@ -149,7 +149,12 @@ def make_sequence_sharded_attention(
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+    strategies = {"ring": ring_attention, "ulysses": ulysses_attention}
+    if strategy not in strategies:
+        raise ValueError(
+            f"sp_strategy {strategy!r}: pick one of {sorted(strategies)}"
+        )
+    fn = strategies[strategy]
     inner = functools.partial(fn, axis_name=axis_name, causal=causal)
     spec = P(None, axis_name, None, None)
 

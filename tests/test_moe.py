@@ -71,6 +71,28 @@ class TestSwitchFFN:
         nonzero = np.abs(y).sum(-1) > 1e-9
         assert nonzero.sum() <= E  # capacity 1 per expert
 
+    def test_bf16_dispatch_exact_past_256_tokens_per_expert(self):
+        # routing math must run in f32/int32 regardless of compute
+        # dtype: bf16 only represents integers exactly up to 256, so a
+        # bf16 cumsum collides capacity positions past slot 256 —
+        # occupancy on the sown seam would exceed 1. 1024 tokens over 2
+        # experts ≈ 512/expert, well past the bf16 integer cliff.
+        E, n, c = 2, 1024, 16
+        m = SwitchFFN(num_experts=E, capacity_factor=2.0, mlp_ratio=2)
+        x = jnp.asarray(
+            np.random.default_rng(7).normal(size=(4, n // 4, c)), jnp.bfloat16
+        )
+        params = m.init(jax.random.PRNGKey(7), x)["params"]
+        _, state = m.apply({"params": params}, x, mutable=["intermediates"])
+        (occ,) = state["intermediates"]["moe_slot_occupancy"]  # [E, cap]
+        occ = np.asarray(occ, np.float32)
+        assert occ.max() <= 1.0 + 1e-6, "capacity slot collision"
+        # every expert filled well past the 256-slot bf16 cliff, and
+        # every routed token landed in a distinct slot
+        per_expert = occ.sum(axis=1)
+        assert per_expert.min() > 256 or per_expert.sum() == n
+        assert occ.sum() == n  # cap=2x: nothing dropped
+
     def test_aux_loss_sown(self):
         m = SwitchFFN(num_experts=4, capacity_factor=2.0)
         x = _x(3)
