@@ -130,10 +130,16 @@ _DEFAULTS: Dict[str, Any] = {
     # (count-weighted) vs the unchunked masked-mean gradient
     "grad_accum_steps": 1,
     # learning-rate schedule (core/optimizers.py): "constant" or
-    # "cosine" (decays over lr_total_steps, linear warmup_steps ramp)
+    # "cosine". Two index bases, exactly one may be set with cosine:
+    # lr_total_steps (optimizer steps — the distributed trainer) or
+    # lr_total_rounds (federation rounds — FL scenarios, where the
+    # client optimizer re-inits per round and the natural semantics is
+    # decay across rounds)
     "lr_schedule": "constant",
     "lr_total_steps": 0,
     "warmup_steps": 0,
+    "lr_total_rounds": 0,
+    "warmup_rounds": 0,
 }
 
 _SECTIONS = (

@@ -117,7 +117,9 @@ def make_local_train_fn(
             loss = loss + 0.5 * prox_mu * sq
         return loss, metrics
 
-    def local_train(params: Params, batches: Batches, rng: jax.Array):
+    def local_train(
+        params: Params, batches: Batches, rng: jax.Array, lr_mult=None
+    ):
         global_params = params
         opt_state = optimizer.init(params)
 
@@ -128,6 +130,11 @@ def make_local_train_fn(
                 p, global_params, x, y, m
             )
             updates, s_new = optimizer.update(grads, s, p)
+            if lr_mult is not None:
+                # round-indexed LR: every _CLIENT_OPTS optimizer ends in
+                # scale_by_learning_rate, so scaling the final updates
+                # == running it with lr * lr_mult this round
+                updates = jax.tree.map(lambda u: u * lr_mult, updates)
             p_new = optax.apply_updates(p, updates)
             nonempty = m.sum() > 0
             p = jax.tree.map(lambda a, b2: jnp.where(nonempty, a, b2), p_new, p)

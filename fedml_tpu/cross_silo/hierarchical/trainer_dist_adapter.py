@@ -87,7 +87,17 @@ class TrainerDistAdapter:
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, self._batch_spec)
 
+        from ...core.optimizers import resolve_round_lr_schedule
+
+        # round-indexed LR (decay across the federation; VERDICT r3 #5)
+        self._round_lr = resolve_round_lr_schedule(args)
         if client_trainer is not None:
+            if self._round_lr is not None:
+                raise ValueError(
+                    "lr_schedule with a custom client_trainer: the "
+                    "trainer owns its optimizer — implement the "
+                    "schedule inside it or use lr_schedule=constant"
+                )
             # L3 operator seam (core/frame.py): the custom pure train fn
             # is simply jitted with the silo's DP shardings — in-silo
             # data parallelism composes with custom operators for free.
@@ -96,25 +106,30 @@ class TrainerDistAdapter:
             local_fn = make_local_train_fn(
                 model.apply,
                 model.loss_fn,
-                create_client_optimizer(args),
+                create_client_optimizer(
+                    args,
+                    lr=float(args.learning_rate)
+                    if self._round_lr is not None
+                    else None,
+                ),
                 epochs=int(args.epochs),
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
                 compute_dtype=compute_dtype_from_args(args),
             )
+        batch_in = Batches(
+            x=self._batch_sharding,
+            y=self._batch_sharding,
+            mask=self._batch_sharding,
+        )
         self._fn = jax.jit(
             local_fn,
             # params/opt-state replicated, batch data-sharded: exactly
             # the DDP layout, declared instead of hand-implemented.
-            in_shardings=(
-                None,
-                Batches(
-                    x=self._batch_sharding,
-                    y=self._batch_sharding,
-                    mask=self._batch_sharding,
-                ),
-                None,
-            ),
+            # (the trailing replicated None is the lr multiplier)
+            in_shardings=(None, batch_in, None)
+            if self._round_lr is None
+            else (None, batch_in, None, None),
             out_shardings=None,
         )
 
@@ -148,7 +163,16 @@ class TrainerDistAdapter:
             # uncommitted host value: identical on every process, so the
             # jit treats it as consistently replicated
             rng = np.asarray(rng)
-        new_params, _metrics = self._fn(params, self._silo_batch(), rng)
+        if self._round_lr is not None:
+            mult = np.float32(
+                float(self._round_lr(round_idx))
+                / float(self.args.learning_rate)
+            )
+            new_params, _metrics = self._fn(
+                params, self._silo_batch(), rng, mult
+            )
+        else:
+            new_params, _metrics = self._fn(params, self._silo_batch(), rng)
         if self.pg.multi_controller:
             # fully-replicated global arrays -> host copies, so the FL
             # message layer (and the server's single-device aggregation)

@@ -13,6 +13,7 @@ import logging
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from ... import constants
 from ...core.frame import bind_operator
@@ -32,7 +33,17 @@ class FedMLTrainer:
         self.dataset = dataset
         self.model = model
         self.client_index: Optional[int] = None
+        from ...core.optimizers import resolve_round_lr_schedule
+
+        # round-indexed LR (decay across the federation; VERDICT r3 #5)
+        self._round_lr = resolve_round_lr_schedule(args)
         if client_trainer is not None:
+            if self._round_lr is not None:
+                raise ValueError(
+                    "lr_schedule with a custom client_trainer: the "
+                    "trainer owns its optimizer — implement the "
+                    "schedule inside it or use lr_schedule=constant"
+                )
             # L3 operator seam (core/frame.py): same custom pure train
             # fn the simulators consume, here jitted per-silo.
             fn = bind_operator(client_trainer, model, args).make_train_fn(args)
@@ -40,7 +51,12 @@ class FedMLTrainer:
             fn = make_local_train_fn(
                 model.apply,
                 model.loss_fn,
-                create_client_optimizer(args),
+                create_client_optimizer(
+                    args,
+                    lr=float(args.learning_rate)
+                    if self._round_lr is not None
+                    else None,
+                ),
                 epochs=int(args.epochs),
                 prox_mu=float(getattr(args, "fedprox_mu", 0.0) or 0.0),
                 shuffle=bool(getattr(args, "shuffle", True)),
@@ -59,7 +75,14 @@ class FedMLTrainer:
             jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
             round_idx * 100003 + i,
         )
-        new_params, metrics = self._fn(params, client, rng)
+        if self._round_lr is not None:
+            mult = jnp.float32(
+                float(self._round_lr(round_idx))
+                / float(self.args.learning_rate)
+            )
+            new_params, metrics = self._fn(params, client, rng, mult)
+        else:
+            new_params, metrics = self._fn(params, client, rng)
         n = float(self.dataset.packed_num_samples[i])
         return new_params, n
 

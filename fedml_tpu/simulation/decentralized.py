@@ -45,6 +45,12 @@ class DecentralizedDSGDAPI(FedAvgAPI):
 
     def __init__(self, args, device, dataset, model, mesh=None) -> None:
         super().__init__(args, device, dataset, model, mesh)
+        if self._round_lr is not None:
+            raise ValueError(
+                "round-indexed lr_schedule is not supported for "
+                "decentralized gossip (no server round clock); use "
+                "lr_schedule=constant"
+            )
         n = dataset.client_num
         packed_rows = int(dataset.packed_train.mask.shape[0])
         if packed_rows != n:
@@ -126,6 +132,7 @@ class DecentralizedPushSumAPI(DecentralizedDSGDAPI):
     directed = True
 
     def __init__(self, args, device, dataset, model, mesh=None) -> None:
+        # (the round-LR refusal lives in the DSGD parent __init__)
         super().__init__(args, device, dataset, model, mesh)
         n = dataset.client_num
         self.mass = jnp.ones((n,))

@@ -40,7 +40,11 @@ from ..core.local_trainer import (
     make_eval_fn,
     make_local_train_fn,
 )
-from ..core.optimizers import create_client_optimizer, create_server_optimizer
+from ..core.optimizers import (
+    create_client_optimizer,
+    create_server_optimizer,
+    resolve_round_lr_schedule,
+)
 from ..core.types import Batches
 from ..data.loader import FederatedDataset
 from ..models.spec import FedModel
@@ -133,7 +137,19 @@ class FedAvgAPI:
         self.rng, init_rng = jax.random.split(self.rng)
         self.global_params = model.init(init_rng)
 
+        # round-indexed LR schedule (decay across the federation, not
+        # within one local fit): None for lr_schedule=constant; loud
+        # ValueError on the ambiguous step-indexed configuration
+        self._round_lr = resolve_round_lr_schedule(args)
         if client_trainer is not None:
+            if self._round_lr is not None:
+                raise ValueError(
+                    "lr_schedule with a custom client_trainer: the "
+                    "trainer owns its optimizer, so the engine cannot "
+                    "apply the round-indexed LR — implement the "
+                    "schedule inside the trainer or use "
+                    "lr_schedule=constant"
+                )
             # L3 operator seam (core/frame.py): the custom trainer's
             # pure train fn replaces the stock one; the engine vmaps /
             # mesh-shards it identically.
@@ -148,7 +164,12 @@ class FedAvgAPI:
             self._local_train = make_local_train_fn(
                 model.apply,
                 model.loss_fn,
-                create_client_optimizer(args),
+                create_client_optimizer(
+                    args,
+                    lr=float(args.learning_rate)
+                    if self._round_lr is not None
+                    else None,
+                ),
                 epochs=int(args.epochs),
                 prox_mu=prox_mu,
                 shuffle=bool(getattr(args, "shuffle", True)),
@@ -209,7 +230,10 @@ class FedAvgAPI:
 
     # -- engine -------------------------------------------------------
     def _build_jitted(self) -> None:
-        def round_fn(global_params, server_state, packed: Batches, nsamples, idx, rng):
+        def round_fn(
+            global_params, server_state, packed: Batches, nsamples, idx, rng,
+            lr_mult=1.0,
+        ):
             cohort = _take(packed, idx)
             ns = jnp.take(nsamples, idx)
             if self.mesh is not None:
@@ -228,9 +252,15 @@ class FedAvgAPI:
                 )
             cohort, server_state = self._preprocess(cohort, server_state)
             rngs = jax.random.split(rng, idx.shape[0])
-            new_stacked, train_metrics = jax.vmap(
-                self._local_train, in_axes=(None, 0, 0)
-            )(global_params, cohort, rngs)
+            if self._round_lr is not None:
+                # round-indexed LR: one multiplier for the whole cohort
+                new_stacked, train_metrics = jax.vmap(
+                    self._local_train, in_axes=(None, 0, 0, None)
+                )(global_params, cohort, rngs, lr_mult)
+            else:
+                new_stacked, train_metrics = jax.vmap(
+                    self._local_train, in_axes=(None, 0, 0)
+                )(global_params, cohort, rngs)
             weights = normalize_weights(ns)
             new_global, new_state = self._aggregate(
                 global_params, server_state, new_stacked, weights, cohort, rng
@@ -285,6 +315,17 @@ class FedAvgAPI:
             if ckpt is not None:
                 ckpt.close()
 
+    def _lr_mult(self, round_idx: int):
+        """Round-indexed LR multiplier (schedule(r) / peak), or None.
+        A numpy scalar: the jit treats it as a traced 0-d argument
+        (compile once, vary per round), and it is a process-consistent
+        host value under multi-controller."""
+        if self._round_lr is None:
+            return None
+        return np.float32(
+            float(self._round_lr(round_idx)) / float(self.args.learning_rate)
+        )
+
     def _train_rounds(
         self, packed, nsamples, comm_rounds, freq, ckpt, start_round
     ) -> Dict[str, float]:
@@ -298,11 +339,15 @@ class FedAvgAPI:
             self.rng, round_rng = jax.random.split(self.rng)
             if self._multi_controller:
                 round_rng = np.asarray(round_rng)  # process-consistent host value
+            lr_mult = self._lr_mult(round_idx)
             with self.profiler.span("round"):
                 if self.mode == "sequential":
-                    new_global, summed = self._sequential_round(idx, round_rng)
+                    new_global, summed = self._sequential_round(
+                        idx, round_rng, lr_mult
+                    )
                     self.global_params = new_global
                 else:
+                    extra = () if lr_mult is None else (lr_mult,)
                     out = self._round_fn(
                         self.global_params,
                         self.server_state,
@@ -310,6 +355,7 @@ class FedAvgAPI:
                         nsamples,
                         np.asarray(idx) if self._multi_controller else jnp.asarray(idx),
                         round_rng,
+                        *extra,
                     )
                     self.global_params, self.server_state, summed = out[:3]
                     if self._keep_stacked:
@@ -378,11 +424,12 @@ class FedAvgAPI:
             state["extra"] = extra
         ckpt.save(round_idx, state)
 
-    def _sequential_round(self, idx: np.ndarray, rng: jax.Array):
+    def _sequential_round(self, idx: np.ndarray, rng: jax.Array, lr_mult=None):
         """Reference §3.1 shape: python loop over sampled clients."""
         stacked_leaves: List[Params] = []
         ns: List[float] = []
         sums = None
+        extra = () if lr_mult is None else (lr_mult,)
         for j, i in enumerate(idx):
             client = Batches(
                 x=self.dataset.packed_train.x[i],
@@ -390,7 +437,7 @@ class FedAvgAPI:
                 mask=self.dataset.packed_train.mask[i],
             )
             p, m = self._local_train_j(
-                self.global_params, client, jax.random.fold_in(rng, j)
+                self.global_params, client, jax.random.fold_in(rng, j), *extra
             )
             stacked_leaves.append(p)
             ns.append(float(self.dataset.packed_num_samples[i]))

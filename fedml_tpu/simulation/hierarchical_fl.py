@@ -56,6 +56,10 @@ class HierarchicalFLAPI(FedAvgAPI):
         for round_idx in range(int(args.comm_round)):
             t0 = time.perf_counter()
             self.rng, round_rng = jax.random.split(self.rng)
+            # round-indexed LR decays with the GLOBAL round (constant
+            # across a round's groups/group-rounds)
+            lr_mult = self._lr_mult(round_idx)
+            extra = () if lr_mult is None else (lr_mult,)
             group_params = []
             group_weights = []
             for gi, g in enumerate(groups):
@@ -70,6 +74,7 @@ class HierarchicalFLAPI(FedAvgAPI):
                         nsamples,
                         jnp.asarray(g),
                         jax.random.fold_in(round_rng, gi * 1009 + gr),
+                        *extra,
                     )
                 group_params.append(p)
                 group_weights.append(float(np.asarray(nsamples)[g].sum()))
