@@ -62,6 +62,163 @@ def shakespeare_to_sequences(snippets: List[str]) -> Tuple[np.ndarray, np.ndarra
     return arr[:, :-1], arr[:, 1:]
 
 
+# -- stackoverflow (TFF h5 + side vocab files) ------------------------
+#
+# Reference: data/stackoverflow_nwp/{utils,dataset}.py and
+# data/stackoverflow_lr/{utils,dataset}.py. Both tasks read the same
+# stackoverflow_{train,test}.h5 (group examples/<client>/ with string
+# datasets ``tokens``, ``title``, ``tags``) plus two side files in the
+# data dir: ``stackoverflow.word_count`` (text lines "word count"; top
+# 10000 words are the vocabulary) and ``stackoverflow.tag_count`` (JSON
+# ordered dict; first 500 keys are the label tags).
+
+SO_SEQ_LEN = 20  # stackoverflow_nwp/utils.py tokenizer max_seq_len
+SO_VOCAB_WORDS = 10000
+SO_TAG_COUNT = 500
+
+
+def load_so_word_vocab(data_dir: str, vocab_size: int = SO_VOCAB_WORDS) -> List[str]:
+    """Top-``vocab_size`` words from ``stackoverflow.word_count``
+    (stackoverflow_nwp/utils.py get_most_frequent_words)."""
+    path = os.path.join(data_dir, "stackoverflow.word_count")
+    words: List[str] = []
+    with open(path) as f:
+        for line in f:
+            if len(words) >= vocab_size:
+                break
+            parts = line.split()
+            if parts:
+                words.append(parts[0])
+    return words
+
+
+def load_so_tag_vocab(data_dir: str, tag_size: int = SO_TAG_COUNT) -> List[str]:
+    """First ``tag_size`` tags from ``stackoverflow.tag_count``
+    (stackoverflow_lr/utils.py get_tags; insertion-ordered JSON)."""
+    import json
+
+    path = os.path.join(data_dir, "stackoverflow.tag_count")
+    with open(path) as f:
+        return list(json.load(f).keys())[:tag_size]
+
+
+def so_nwp_to_sequences(
+    sentences: List[str], words: List[str], word_id: Optional[Dict] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sentences -> (x [N,20], y [N,20]) next-word-prediction pairs.
+
+    Token ids follow stackoverflow_nwp/utils.py exactly: pad=0, words
+    1..V, bos=V+1, eos=V+2, oov=V+3 (one OOV bucket); each sentence is
+    truncated to 20 words, gets EOS only if shorter, BOS prepended,
+    padded to 21; x = w[:-1], y = w[1:]. Pass a precomputed ``word_id``
+    ({word: id starting at 1}) when calling per-client — the real
+    dataset has 342k clients and a fresh 10k-entry dict per call is
+    pure waste."""
+    if word_id is None:
+        word_id = {w: i + 1 for i, w in enumerate(words)}
+    bos, eos, oov = len(words) + 1, len(words) + 2, len(words) + 3
+    win = SO_SEQ_LEN + 1
+    seqs: List[List[int]] = []
+    for s in sentences:
+        toks = [word_id.get(t, oov) for t in s.split(" ")[:SO_SEQ_LEN]]
+        if len(toks) < SO_SEQ_LEN:
+            toks.append(eos)
+        toks = [bos] + toks
+        toks += [0] * (win - len(toks))
+        seqs.append(toks)
+    if not seqs:
+        e = np.zeros((0, SO_SEQ_LEN), np.int32)
+        return e, e.copy()
+    arr = np.asarray(seqs, np.int32)
+    return arr[:, :-1], arr[:, 1:]
+
+
+def so_lr_features(
+    sentences: List[str], words: List[str], word_id: Optional[Dict] = None
+) -> np.ndarray:
+    """tokens+title strings -> mean bag-of-words [N, V] over the word
+    vocabulary (stackoverflow_lr/utils.py preprocess_inputs: the OOV
+    bucket participates in the mean but is sliced off). ``word_id``
+    ({word: 0-based id}) as in :func:`so_nwp_to_sequences`."""
+    if word_id is None:
+        word_id = {w: i for i, w in enumerate(words)}
+    v = len(words)
+    out = np.zeros((len(sentences), v), np.float32)
+    for n, s in enumerate(sentences):
+        toks = s.split(" ")
+        if not toks:
+            continue
+        for t in toks:
+            i = word_id.get(t)
+            if i is not None:
+                out[n, i] += 1.0
+        out[n] /= float(len(toks))
+    return out
+
+
+def so_lr_targets(
+    tag_strs: List[str], tags: List[str], tag_id: Optional[Dict] = None
+) -> np.ndarray:
+    """'|'-joined tag strings -> multi-hot [N, T]
+    (stackoverflow_lr/utils.py preprocess_targets; the reference emits
+    raw per-tag counts incl. an OOV bucket — here clipped to {0,1} over
+    the T label tags, which is what its 500-way sigmoid head consumes)."""
+    if tag_id is None:
+        tag_id = {t: i for i, t in enumerate(tags)}
+    out = np.zeros((len(tag_strs), len(tags)), np.float32)
+    for n, ts in enumerate(tag_strs):
+        for t in ts.split("|"):
+            i = tag_id.get(t)
+            if i is not None:
+                out[n, i] = 1.0
+    return out
+
+
+def _so_examples_group(f):
+    # canonical TFF layout uses "examples"; the reference's reader keys
+    # on "examples.md" (stackoverflow_nwp/dataset.py:21) — accept both
+    for key in ("examples", "examples.md"):
+        if key in f:
+            return f[key]
+    raise KeyError("no 'examples' group in stackoverflow h5")
+
+
+def _read_stackoverflow_split(
+    path: str, task: str, words: List[str], tags: List[str]
+):
+    """One stackoverflow h5 split -> (client_ids, xs, ys)."""
+    import h5py
+
+    def dec(v) -> str:
+        return v.decode("utf8") if isinstance(v, bytes) else str(v)
+
+    # id maps built ONCE, not per client (342k clients on the real set)
+    if task == "nwp":
+        word_id = {w: i + 1 for i, w in enumerate(words)}
+    else:
+        word_id = {w: i for i, w in enumerate(words)}
+        tag_id = {t: i for i, t in enumerate(tags)}
+    ids, xs, ys = [], [], []
+    with h5py.File(path, "r") as f:
+        examples = _so_examples_group(f)
+        for cid in sorted(examples.keys()):
+            g = examples[cid]
+            toks = [dec(s) for s in g["tokens"][()]]
+            if task == "nwp":
+                x, y = so_nwp_to_sequences(toks, words, word_id)
+            else:
+                titles = [dec(s) for s in g["title"][()]]
+                sents = [" ".join([t, ti]) for t, ti in zip(toks, titles)]
+                x = so_lr_features(sents, words, word_id)
+                y = so_lr_targets(
+                    [dec(s) for s in g["tags"][()]], tags, tag_id
+                )
+            ids.append(cid)
+            xs.append(x)
+            ys.append(y)
+    return ids, xs, ys
+
+
 def _h5_split_path(data_dir: str, candidates: List[str]) -> Optional[str]:
     for name in candidates:
         p = os.path.join(data_dir, name)
@@ -108,6 +265,10 @@ def _tff_names(dataset: str, split: str) -> List[str]:
         names.append(f"fed_cifar100_{split}.h5")
     if dataset == "fed_emnist" or dataset == "femnist":
         names.append(f"fed_emnist_{split}.h5")
+    if dataset.startswith("stackoverflow"):
+        # both SO tasks read the same artifact (reference
+        # stackoverflow_nwp/data_loader.py DEFAULT_TRAIN_FILE)
+        names.append(f"stackoverflow_{split}.h5")
     return names
 
 
@@ -119,25 +280,32 @@ def load_tff_h5(
     Train clients define the federation (reference: train/test client
     id sets differ in size, fed_cifar100 500/100); a train client with
     no test group gets an empty test set."""
-    image_key = "snippets" if "shakespeare" in dataset else (
-        "pixels" if "emnist" in dataset else "image"
-    )
     train_path = _h5_split_path(data_dir, _tff_names(dataset, "train"))
     test_path = _h5_split_path(data_dir, _tff_names(dataset, "test"))
     if train_path is None:
         raise FileNotFoundError(f"no TFF h5 train split for {dataset} in {data_dir}")
-    ids, xs_tr, ys_tr = _read_tff_split(train_path, image_key)
+    if dataset.startswith("stackoverflow"):
+        task = "nwp" if dataset.endswith("nwp") else "lr"
+        words = load_so_word_vocab(data_dir)
+        tags = load_so_tag_vocab(data_dir) if task == "lr" else []
+        read = lambda p: _read_stackoverflow_split(p, task, words, tags)
+    else:
+        image_key = "snippets" if "shakespeare" in dataset else (
+            "pixels" if "emnist" in dataset else "image"
+        )
+        read = lambda p: _read_tff_split(p, image_key)
+    ids, xs_tr, ys_tr = read(train_path)
     test_map = {}
     if test_path is not None:
-        te_ids, xs_te, ys_te = _read_tff_split(test_path, image_key)
+        te_ids, xs_te, ys_te = read(test_path)
         test_map = {c: (x, y) for c, x, y in zip(te_ids, xs_te, ys_te)}
     xs_te_out, ys_te_out = [], []
-    for cid, x in zip(ids, xs_tr):
+    for cid, x, y0 in zip(ids, xs_tr, ys_tr):
         if cid in test_map:
             xt, yt = test_map[cid]
         else:
             xt = np.zeros((0,) + x.shape[1:], x.dtype)
-            yt = np.zeros((0,), np.int64)
+            yt = np.zeros((0,) + y0.shape[1:], y0.dtype)
         xs_te_out.append(xt)
         ys_te_out.append(yt)
     logging.info(

@@ -166,6 +166,116 @@ class TestTffH5:
         assert ds.packed_train.x.dtype == np.int32
 
 
+def _write_stackoverflow(dirpath, n_clients=3):
+    """Real-format stackoverflow artifacts: stackoverflow_{split}.h5
+    (examples/<client>/{tokens,title,tags}) + the word_count/tag_count
+    side files (reference stackoverflow_nwp/utils.py:20-28,
+    stackoverflow_lr/utils.py:35-45)."""
+    import json
+
+    import h5py
+
+    os.makedirs(dirpath, exist_ok=True)
+    words = ["how", "to", "use", "python", "list", "sort", "fast", "index"]
+    with open(os.path.join(dirpath, "stackoverflow.word_count"), "w") as f:
+        for i, w in enumerate(words):
+            f.write(f"{w} {1000 - i}\n")
+    tags = {"python": 900, "sorting": 500, "performance": 300}
+    with open(os.path.join(dirpath, "stackoverflow.tag_count"), "w") as f:
+        json.dump(tags, f)
+    sentences = [
+        b"how to sort a python list",
+        b"use index to find fast",
+        b"python list sort",
+    ]
+    titles = [b"sorting question", b"index question", b"sort help"]
+    tag_rows = [b"python|sorting", b"performance", b"python"]
+    for split, k in (("train", 3), ("test", 2)):
+        with h5py.File(
+            os.path.join(dirpath, f"stackoverflow_{split}.h5"), "w"
+        ) as f:
+            g = f.create_group("examples")
+            for c in range(n_clients):
+                cg = g.create_group(f"user_{c}")
+                cg.create_dataset("tokens", data=sentences[:k])
+                cg.create_dataset("title", data=titles[:k])
+                cg.create_dataset("tags", data=tag_rows[:k])
+
+
+class TestStackoverflow:
+    def test_nwp_loads(self, tmp_path, args_factory):
+        d = tmp_path / "stackoverflow_nwp"
+        _write_stackoverflow(str(d))
+        args = _args(
+            args_factory,
+            dataset="stackoverflow_nwp",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=3,
+            client_num_per_round=3,
+            model="rnn",
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 3
+        assert ds.task == "nwp"
+        assert ds.packed_train.x.shape[-1] == 20  # SO_SEQ_LEN
+        assert ds.packed_train.x.dtype == np.int32
+
+    def test_lr_loads(self, tmp_path, args_factory):
+        d = tmp_path / "stackoverflow_lr"
+        _write_stackoverflow(str(d))
+        args = _args(
+            args_factory,
+            dataset="stackoverflow_lr",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=3,
+            client_num_per_round=3,
+            model="lr",
+        )
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        assert ds.client_num == 3
+        assert ds.task == "tag_prediction"
+        # bag-of-words over the 8-word fixture vocab
+        assert ds.packed_train.x.shape[-1] == 8
+        assert args.input_dim == 8
+        # multi-hot over the 3 fixture label tags
+        assert ds.packed_train.y.shape[-1] == 3
+        assert set(np.unique(ds.packed_train.y)) <= {0.0, 1.0}
+
+    def test_nwp_token_ids(self):
+        from fedml_tpu.data.ingest import so_nwp_to_sequences
+
+        words = ["how", "to", "sort"]
+        bos, eos, oov = 4, 5, 6
+        x, y = so_nwp_to_sequences(["how to sort quickly"], words)
+        assert x.shape == (1, 20) and y.shape == (1, 20)
+        # x = [bos how to sort oov eos pad...]; y shifted by one
+        assert x[0, 0] == bos
+        assert list(x[0, 1:5]) == [1, 2, 3, oov]
+        assert y[0, 4] == eos  # short sentence gets EOS
+        assert (y[0, 5:] == 0).all()
+        assert y[0, 0] == x[0, 1]
+
+    def test_nwp_truncates_to_20(self):
+        from fedml_tpu.data.ingest import so_nwp_to_sequences
+
+        x, y = so_nwp_to_sequences(["w " * 50], ["w"])
+        assert x.shape == (1, 20)
+        # truncated sentences get no EOS (reference tokenizer: EOS only
+        # when shorter than max_seq_len); eos id = len(vocab)+2 = 3
+        assert (y[0] != 0).all() and 3 not in y[0]
+
+    def test_lr_feature_and_target_math(self):
+        from fedml_tpu.data.ingest import so_lr_features, so_lr_targets
+
+        f = so_lr_features(["a b unknown"], ["a", "b"])
+        # mean over ALL 3 tokens (OOV participates in the denominator)
+        np.testing.assert_allclose(f, [[1 / 3, 1 / 3]])
+        t = so_lr_targets(["a|c|a"], ["a", "b"])
+        np.testing.assert_array_equal(t, [[1.0, 0.0]])
+
+
 class TestShakespearePreprocess:
     def test_windows_and_specials(self):
         x, y = shakespeare_to_sequences(["ab"])
