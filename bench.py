@@ -12,8 +12,12 @@ aggregation, fedavg_api.py:102-115 / _aggregate — implemented with the
 same jitted per-client step so the comparison isolates architecture).
 
 ``detail`` carries the BASELINE.md "new metrics to establish":
+- ``dense``: the compute-dense north-star cohort (100-client FedAvg,
+  ResNet-18(GN)/CIFAR-10-shape, 10/round, bf16) with samples/s/chip
+  and ``mfu_vs_bf16_peak`` — the MFU figure that means something (the
+  tiny-CNN headline is latency-bound by design);
 - ``scaling``: 8->256 simulated-client sweep — cohort size vs rounds/s
-  and client samples/s. ``throughput_retention_vs_8`` = sps(C)/sps(8):
+  and client samples/s. ``throughput_retention_vs_base`` = sps(C)/sps(base):
   on a single chip, ~1.0 means the vectorized engine keeps the chip
   saturated as the cohort grows 32x (cohorts are compute-bound, not
   dispatch-bound); ``per_client_efficiency`` is the strong-scaling view
@@ -32,9 +36,12 @@ same jitted per-client step so the comparison isolates architecture).
 - ``bf16``: the same cohort under dtype=bfloat16 (core/local_trainer.py
   mixed precision) and its speedup over the f32 headline.
 
-Robustness contract (VERDICT round 1, hardened round 3): TPU init is
-probed in a subprocess with a timeout; on failure we retry then fall
-back to a scaled-down CPU run. Every TPU phase additionally runs in
+Robustness contract (VERDICT round 1, hardened rounds 3-4): TPU init
+is probed in a subprocess with a timeout; on failure we retry then
+fall back to a scaled-down CPU run whose numbers are demoted to
+``*_cpu_fallback`` keys, and the TPU is RE-probed after the fallback
+completes — the tunnel is flaky, not dead, so a late recovery promotes
+a real TPU headline over the fallback. Every TPU phase additionally runs in
 its OWN subprocess with its own timeout — observed failure mode: a
 large sweep cohort can wedge the TPU tunnel mid-run, which would
 otherwise hang the whole bench past the driver's window. A wedged
@@ -80,7 +87,9 @@ def _progress(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
-def _probe_tpu() -> tuple[bool, str]:
+def _probe_tpu(
+    timeout_s: float = PROBE_TIMEOUT_S, attempts: int = PROBE_ATTEMPTS
+) -> tuple[bool, str]:
     """Initialize the TPU backend in a subprocess (bounded time)."""
     code = (
         "import jax, jax.numpy as jnp;"
@@ -92,7 +101,7 @@ def _probe_tpu() -> tuple[bool, str]:
     )
     env = _child_env()
     last = ""
-    for attempt in range(PROBE_ATTEMPTS):
+    for attempt in range(attempts):
         if attempt:
             time.sleep(5 * attempt)
         try:
@@ -100,7 +109,7 @@ def _probe_tpu() -> tuple[bool, str]:
                 [sys.executable, "-c", code],
                 capture_output=True,
                 text=True,
-                timeout=PROBE_TIMEOUT_S,
+                timeout=timeout_s,
                 env=env,
             )
             if r.returncode == 0 and "PROBE_OK" in r.stdout:
@@ -110,7 +119,7 @@ def _probe_tpu() -> tuple[bool, str]:
         except subprocess.TimeoutExpired:
             # a stalled tunnel stays stalled — retrying only burns the
             # CPU fallback's budget. Retry is for quick crashes only.
-            return False, f"probe timeout after {PROBE_TIMEOUT_S}s"
+            return False, f"probe timeout after {timeout_s:.0f}s"
     return False, last
 
 
@@ -128,7 +137,7 @@ def _build_api(n_clients: int, epochs: int, per_client: int = 600, **extra):
     from fedml_tpu.simulation import FedAvgAPI
 
     args = Arguments()
-    for k, v in dict(
+    cfg = dict(
         dataset="femnist",
         synthetic_train_size=n_clients * per_client,
         synthetic_test_size=2000,
@@ -143,8 +152,9 @@ def _build_api(n_clients: int, epochs: int, per_client: int = 600, **extra):
         learning_rate=0.03,
         frequency_of_the_test=10**9,
         matmul_precision="default",
-        **extra,
-    ).items():
+    )
+    cfg.update(extra)  # extras override the base config (dense phase)
+    for k, v in cfg.items():
         setattr(args, k, v)
     args._validate()
     args = fedml_tpu.init(args)
@@ -273,6 +283,29 @@ def _aggregation_exchange(model, n_iter: int = 20) -> dict:
     }
 
 
+def _mfu_detail(flops: float, rps: float, n_chips: int = 1) -> dict:
+    """Achieved FLOP/s (+ MFU when the device kind's peak is known).
+
+    cost_analysis is XLA's static estimate (it undercounts fused convs)
+    — the figure exists so utilization is judgeable, not to flatter it.
+    """
+    import jax
+
+    out = {
+        "model_flops_per_sec": round(flops * rps, 1),
+        "flops_source": "xla_cost_analysis (static estimate)",
+    }
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    # longest-match so e.g. a hypothetical "TPU v4i" never matches
+    # the "TPU v4" entry's peak
+    matches = [(len(k), v) for k, v in _PEAK_TFLOPS.items() if k.lower() in kind]
+    if matches:
+        peak = max(matches)[1] * 1e12
+        out["mfu_vs_bf16_peak"] = round(flops * rps / (peak * n_chips), 4)
+        out["peak_assumed_tflops"] = peak / 1e12
+    return out
+
+
 def _headline_cohort(on_cpu: bool) -> dict:
     """Shared by the f32 headline and the bf16 phase — their cohorts
     MUST match or detail.bf16.speedup_vs_f32 compares different work.
@@ -321,25 +354,11 @@ def run_headline(on_cpu: bool) -> dict:
         "n_devices_visible": len(jax.devices()),
     }
 
-    # MFU: XLA's own flop count for the round computation over wall
-    # time. Honest caveats: cost_analysis is XLA's static estimate (it
-    # undercounts fused convs), and small-model FL at batch 32 is
-    # latency/HBM-bound by nature — the figure exists so utilization is
-    # judgeable, not to flatter it.
+    # MFU of the small-CNN headline: small-model FL at batch 32 is
+    # latency/HBM-bound by nature — the compute-dense phase (run_dense)
+    # is where a meaningful MFU comes from; this one is context only.
     if flops:
-        achieved = flops * vec_rps
-        detail["model_flops_per_sec"] = round(achieved, 1)
-        detail["flops_source"] = "xla_cost_analysis (static estimate)"
-        kind = getattr(jax.devices()[0], "device_kind", "").lower()
-        # longest-match so e.g. a hypothetical "TPU v4i" never matches
-        # the "TPU v4" entry's peak
-        matches = [
-            (len(k), v) for k, v in _PEAK_TFLOPS.items() if k.lower() in kind
-        ]
-        peak = max(matches)[1] * 1e12 if matches else None
-        if peak:
-            detail["mfu_vs_bf16_peak"] = round(achieved / (peak * n_chips), 4)
-            detail["peak_assumed_tflops"] = peak / 1e12
+        detail.update(_mfu_detail(flops, vec_rps, n_chips))
 
     detail["aggregation_exchange"] = _aggregation_exchange(model)
 
@@ -369,6 +388,51 @@ def run_bf16(on_cpu: bool) -> dict:
         "rounds_per_sec": round(rps, 4),
         "samples_per_sec": round(rps * spr, 1),
     }
+
+
+def run_dense(on_cpu: bool) -> dict:
+    """Compute-dense phase: the BASELINE.json north-star cohort —
+    100-client FedAvg, ResNet-18(GN)/CIFAR-10-shape, 10 clients/round,
+    bf16 — big enough that samples/s/chip and MFU are meaningful
+    (the tiny-CNN headline cannot demonstrate MFU; VERDICT r3 weak #2).
+    """
+    if on_cpu:
+        # vmapped conv gradients hit XLA:CPU's slow fallback path (a
+        # ResNet cohort round takes minutes) — exercise the phase
+        # plumbing with the small CNN instead; numbers are demoted
+        cohort = dict(total=4, per_round=2, per_client=64, batch=16, n_rounds=1)
+        model_name = "cnn"
+    else:
+        cohort = dict(
+            total=100, per_round=10, per_client=500, batch=64, n_rounds=5
+        )
+        model_name = "resnet18"
+    args, dataset, _model, api = _build_api(
+        cohort["total"],
+        epochs=1,
+        per_client=cohort["per_client"],
+        dataset="cifar10",
+        model=model_name,
+        batch_size=cohort["batch"],
+        client_num_per_round=cohort["per_round"],
+        dtype="bfloat16",
+    )
+    _progress(f"dense ({model_name}/cifar10) built")
+    rps, spr, flops = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    _progress(f"dense timed: {rps:.3f} rounds/s")
+    out = {
+        "model": "resnet18_gn" if not on_cpu else "cnn (cpu fallback stand-in)",
+        "dataset_shape": "cifar10 (32x32x3, 10 classes)",
+        "clients_total": cohort["total"],
+        "clients_per_round": cohort["per_round"],
+        "batch_size": cohort["batch"],
+        "dtype": "bfloat16",
+        "rounds_per_sec": round(rps, 4),
+        "samples_per_sec_per_chip": round(rps * spr, 1),
+    }
+    if flops:
+        out.update(_mfu_detail(flops, rps))
+    return out
 
 
 def run_sweep_cohort(c: int) -> dict:
@@ -429,12 +493,16 @@ def _run_phase_subprocess(phase_args, timeout_s: float):
 
 
 # total wall budget: the driver gives bench ~580s. Leave headroom for
-# probe (worst 120s) + interpreter startups.
+# probe (worst 120s) + interpreter startups. Phase order encodes
+# priority (budget gates skip the tail): headline -> dense (MFU) ->
+# sweep -> bf16.
 _BUDGET_S = 560.0
-_HEADLINE_TIMEOUT_S = 290.0
-_BF16_TIMEOUT_S = 110.0
-_SWEEP_TIMEOUT_S = 70.0
+_HEADLINE_TIMEOUT_S = 270.0
+_DENSE_TIMEOUT_S = 130.0
+_BF16_TIMEOUT_S = 90.0
+_SWEEP_TIMEOUT_S = 60.0
 _SWEEP_COHORTS = [8, 32, 256]
+_LATE_PROBE_TIMEOUT_S = 60.0
 
 
 def _elapsed() -> float:
@@ -456,12 +524,25 @@ def main() -> None:
         )
 
 
+def _demote_fallback(result: dict, note: str) -> None:
+    """CPU-fallback numbers must not read as TPU numbers in cross-round
+    JSON diffs (VERDICT r3 weak #1): mirror them into *_cpu_fallback
+    keys and stamp the unit. Top-level value stays populated (driver
+    schema) but is now self-describing."""
+    result["cpu_fallback"] = True
+    result["value_cpu_fallback"] = result["value"]
+    result["vs_baseline_cpu_fallback"] = result["vs_baseline"]
+    result["unit"] += " [CPU FALLBACK — not comparable to TPU rounds]"
+    result["error"] = f"TPU unavailable, CPU fallback: {note}"
+
+
 def _main_guarded() -> None:
     _progress("probing TPU")
     tpu_ok, note = _probe_tpu()
     _progress(f"probe: ok={tpu_ok} ({note})")
 
     result = None
+    cnote = ""
     if tpu_ok:
         result, hnote = _run_phase_subprocess(
             ["--phase", "headline"], _HEADLINE_TIMEOUT_S
@@ -473,13 +554,40 @@ def _main_guarded() -> None:
 
     if result is None:
         # CPU fallback in a child too (parent never imports jax, so a
-        # wedged backend can never take down the emit path)
+        # wedged backend can never take down the emit path). Cap it so
+        # a late TPU re-probe still has budget (the tunnel is flaky,
+        # not dead — it can come back mid-bench).
         result, cnote = _run_phase_subprocess(
             ["--phase", "headline", "--cpu"],
-            max(120.0, _BUDGET_S - _elapsed() - 10),
+            max(120.0, _BUDGET_S - _elapsed() - _LATE_PROBE_TIMEOUT_S - 120),
         )
         if result is not None:
-            result["error"] = f"TPU unavailable, CPU fallback: {note}"
+            _demote_fallback(result, note)
+
+        # second chance: re-probe with whatever budget is left and
+        # promote a TPU headline over the fallback (VERDICT r3 #1a)
+        remaining = _BUDGET_S - _elapsed()
+        if remaining > _LATE_PROBE_TIMEOUT_S + 60:
+            _progress("late TPU re-probe")
+            tpu_ok, lnote = _probe_tpu(_LATE_PROBE_TIMEOUT_S, attempts=1)
+            _progress(f"late probe: ok={tpu_ok} ({lnote})")
+            if tpu_ok:
+                remaining = _BUDGET_S - _elapsed()
+                late, hnote = _run_phase_subprocess(
+                    ["--phase", "headline"],
+                    min(_HEADLINE_TIMEOUT_S, remaining - 10),
+                )
+                if late is not None:
+                    late["detail"]["tpu_recovered_late"] = True
+                    if result is not None:
+                        late["detail"]["cpu_fallback_headline"] = {
+                            "value": result["value"],
+                            "vs_baseline": result["vs_baseline"],
+                        }
+                    result = late
+                else:
+                    _progress(f"late TPU headline failed ({hnote})")
+                    tpu_ok = False
 
     if result is None:
         _emit(
@@ -493,24 +601,26 @@ def _main_guarded() -> None:
         )
         return
 
-    if tpu_ok:
-        # mixed-precision point (own child): bf16 vs the f32 headline
-        remaining = _BUDGET_S - _elapsed()
-        if remaining > 100:
-            bf16, bnote = _run_phase_subprocess(
-                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
-            )
-            if bf16 is not None:
-                bf16["speedup_vs_f32"] = round(
-                    bf16["rounds_per_sec"] / max(result["value"], 1e-9), 2
-                )
-                result["detail"]["bf16"] = bf16
-            else:
-                result["detail"]["bf16_skipped"] = bnote
-                _progress(f"bf16 phase skipped ({bnote})")
+    # compute-dense phase (ResNet-18/CIFAR-10, bf16): the MFU number
+    # that matters. On TPU it runs the north-star cohort; on fallback a
+    # demoted mini-cohort so the phase is still exercised.
+    remaining = _BUDGET_S - _elapsed()
+    if remaining > 60:
+        dense_args = ["--phase", "dense"] + ([] if tpu_ok else ["--cpu"])
+        dense, dnote = _run_phase_subprocess(
+            dense_args, min(_DENSE_TIMEOUT_S, remaining - 10)
+        )
+        if dense is not None:
+            if not tpu_ok:
+                dense["cpu_fallback"] = True
+            result["detail"]["dense"] = dense
         else:
-            result["detail"]["bf16_skipped"] = "budget exhausted"
+            result["detail"]["dense_skipped"] = dnote
+            _progress(f"dense phase skipped ({dnote})")
+    else:
+        result["detail"]["dense_skipped"] = "budget exhausted"
 
+    if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
         # cohort big enough to wedge the tunnel can only cost itself
         scaling, skipped = [], []
@@ -533,7 +643,7 @@ def _main_guarded() -> None:
             base = min(scaling, key=lambda e: e["clients"])
             base_sps = max(base["samples_per_sec"], 1e-9)
             for e in scaling:
-                e["throughput_retention_vs_8"] = round(
+                e["throughput_retention_vs_base"] = round(
                     e["samples_per_sec"] / base_sps, 3
                 )
                 e["per_client_efficiency"] = round(
@@ -547,6 +657,23 @@ def _main_guarded() -> None:
             # no silent caps: record what was dropped and why
             result["detail"]["scaling_skipped"] = skipped
 
+        # mixed-precision point (own child): bf16 vs the f32 headline
+        remaining = _BUDGET_S - _elapsed()
+        if remaining > 100:
+            bf16, bnote = _run_phase_subprocess(
+                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
+            )
+            if bf16 is not None:
+                bf16["speedup_vs_f32"] = round(
+                    bf16["rounds_per_sec"] / max(result["value"], 1e-9), 2
+                )
+                result["detail"]["bf16"] = bf16
+            else:
+                result["detail"]["bf16_skipped"] = bnote
+                _progress(f"bf16 phase skipped ({bnote})")
+        else:
+            result["detail"]["bf16_skipped"] = "budget exhausted"
+
     _emit(result)
 
 
@@ -555,7 +682,9 @@ def _phase_main(argv) -> None:
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--phase", required=True, choices=["headline", "bf16", "sweep"])
+    p.add_argument(
+        "--phase", required=True, choices=["headline", "bf16", "dense", "sweep"]
+    )
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", required=True)
@@ -566,6 +695,8 @@ def _phase_main(argv) -> None:
         out = run_headline(on_cpu=a.cpu)
     elif a.phase == "bf16":
         out = run_bf16(on_cpu=a.cpu)
+    elif a.phase == "dense":
+        out = run_dense(on_cpu=a.cpu)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:
