@@ -140,6 +140,10 @@ _DEFAULTS: Dict[str, Any] = {
     "warmup_steps": 0,
     "lr_total_rounds": 0,
     "warmup_rounds": 0,
+    # auto-fetch supported dataset archives into data_cache_dir when no
+    # local copy exists (reference data/MNIST/data_loader.py:17-29
+    # behavior; off by default so offline runs never stall on egress)
+    "download": False,
 }
 
 _SECTIONS = (

@@ -4,6 +4,10 @@ Parity with the reference's ``python/fedml/constants.py`` (scenario names,
 partition methods, backend names), extended with TPU-native backends.
 """
 
+# MNIST LEAF archive (reference constants.py:18; data/MNIST/
+# data_loader.py:17-29 downloads + extracts it)
+FEDML_DATA_MNIST_URL = "https://fedcv.s3.us-west-1.amazonaws.com/MNIST.zip"
+
 FEDML_TRAINING_PLATFORM_SIMULATION = "simulation"
 FEDML_TRAINING_PLATFORM_CROSS_SILO = "cross_silo"
 FEDML_TRAINING_PLATFORM_CROSS_DEVICE = "cross_device"

@@ -1,0 +1,179 @@
+"""Dataset download seam with offline grace + bundled real-data path.
+
+Reference parity: ``data/MNIST/data_loader.py:17-29`` (download_mnist:
+fetch ``MNIST.zip`` from ``FEDML_DATA_MNIST_URL`` — reference
+``constants.py:18`` — into ``data_cache_dir`` and extract; the archive
+carries the LEAF json layout ``MNIST/{train,test}/*.json``).
+
+Two deliberate deviations:
+
+- **Offline grace**: the reference's ``wget.download`` raises and kills
+  the run when there is no egress; here any network failure logs a
+  warning and returns False so the caller can fall back (loader.py
+  degrades to its synthetic stand-in, scripts/reproduce_baseline.py to
+  the bundled real-digits subset below).
+- **Bundled real data**: :func:`materialize_real_digits` writes the UCI
+  ML hand-written digits set (1797 REAL handwritten digit images,
+  shipped inside scikit-learn — available with zero egress) into the
+  exact MNIST LEAF json layout: 8x8 images are upsampled to 28x28,
+  scaled to [0,1], flattened to 784 like the reference's MNIST json,
+  and split across users with a Dirichlet label skew so the federation
+  is naturally non-IID. This is NOT MNIST — file/metric names say
+  "digits" wherever the distinction matters — but it IS genuinely real
+  data in the reference's on-disk format, which is what the
+  accuracy-reproduction path needs when the real archive can't be
+  fetched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import urllib.request
+import zipfile
+from typing import Optional
+
+from ..constants import FEDML_DATA_MNIST_URL
+
+_DOWNLOAD_TIMEOUT_S = 15
+
+
+def download_mnist(
+    data_cache_dir: str, url: str = FEDML_DATA_MNIST_URL
+) -> bool:
+    """Fetch + extract the reference MNIST LEAF archive; False on any
+    failure (offline grace — the caller picks the fallback)."""
+    os.makedirs(data_cache_dir, exist_ok=True)
+    zip_path = os.path.join(data_cache_dir, "MNIST.zip")
+
+    def fetch() -> None:
+        tmp_name = None
+        try:
+            with urllib.request.urlopen(
+                url, timeout=_DOWNLOAD_TIMEOUT_S
+            ) as r, tempfile.NamedTemporaryFile(
+                dir=data_cache_dir, delete=False
+            ) as tmp:
+                tmp_name = tmp.name
+                shutil.copyfileobj(r, tmp)
+            os.replace(tmp_name, zip_path)
+            tmp_name = None
+        finally:
+            if tmp_name is not None:  # failed mid-copy: no orphans
+                try:
+                    os.unlink(tmp_name)
+                except OSError:
+                    pass
+
+    try:
+        if not os.path.exists(zip_path):
+            fetch()
+        try:
+            with zipfile.ZipFile(zip_path, "r") as zf:
+                zf.extractall(data_cache_dir)
+        except zipfile.BadZipFile:
+            # a truncated archive (e.g. an interrupted earlier download)
+            # must not disable the path forever: refetch once
+            logging.warning("corrupt %s; re-downloading", zip_path)
+            os.unlink(zip_path)
+            fetch()
+            with zipfile.ZipFile(zip_path, "r") as zf:
+                zf.extractall(data_cache_dir)
+    except Exception as e:  # noqa: BLE001 — offline grace is the point
+        logging.warning(
+            "mnist download unavailable (%s: %s); proceeding without it",
+            type(e).__name__, e,
+        )
+        return False
+    # loader resolves <cache>/<lowercase name>; the reference archive
+    # extracts as MNIST/
+    upper = os.path.join(data_cache_dir, "MNIST")
+    lower = os.path.join(data_cache_dir, "mnist")
+    if os.path.isdir(upper) and not os.path.isdir(lower):
+        os.rename(upper, lower)
+    return os.path.isdir(os.path.join(lower, "train"))
+
+
+def materialize_real_digits(
+    data_cache_dir: str,
+    n_users: int = 100,
+    alpha: float = 0.5,
+    seed: int = 0,
+    name: str = "mnist",
+) -> Optional[str]:
+    """Write the sklearn real-digits set as a MNIST-format LEAF dir.
+
+    Returns the dataset dir (``<cache>/<name>``), or None when sklearn
+    is unavailable. ~1437 train / 360 test real images over ``n_users``
+    Dirichlet(alpha)-skewed users.
+    """
+    try:
+        from sklearn.datasets import load_digits
+    except Exception:  # noqa: BLE001 — optional dependency
+        logging.warning("scikit-learn unavailable; no bundled real digits")
+        return None
+    import numpy as np
+
+    d = load_digits()
+    x = d.data.reshape(-1, 8, 8).astype(np.float32) / 16.0
+    # upsample 8x8 -> 28x28 (nearest via index map; no PIL dependency)
+    idx = (np.arange(28) * 8) // 28
+    x = x[:, idx][:, :, idx].reshape(len(x), 784)
+    y = d.target.astype(np.int64)
+
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(x))
+    x, y = x[perm], y[perm]
+
+    # Dirichlet label skew over users FIRST (the LEAF per-user grouping
+    # IS the partition, so the non-IID is baked into the user split),
+    # then an 80/20 per-user train/test split — train and test share
+    # the same user set, the reference read_data assumption
+    # (data/MNIST/data_loader.py:37-38).
+    user_of = np.empty(len(y), np.int64)
+    for c in range(10):
+        rows = np.where(y == c)[0]
+        p = rng.dirichlet([alpha] * n_users)
+        user_of[rows] = rng.choice(n_users, size=len(rows), p=p)
+
+    blobs = {
+        s: {"users": [], "num_samples": [], "user_data": {}}
+        for s in ("train", "test")
+    }
+    for u in range(n_users):
+        rows = np.where(user_of == u)[0]
+        if len(rows) == 0:
+            continue
+        uid = f"u_{u:05d}"
+        k = max(1, int(0.8 * len(rows)))
+        for split, sel in (("train", rows[:k]), ("test", rows[k:])):
+            blobs[split]["users"].append(uid)
+            blobs[split]["num_samples"].append(int(len(sel)))
+            blobs[split]["user_data"][uid] = {
+                "x": [[round(float(v), 4) for v in row] for row in x[sel]],
+                "y": [int(v) for v in y[sel]],
+            }
+
+    root = os.path.join(data_cache_dir, name)
+    for split, blob in blobs.items():
+        os.makedirs(os.path.join(root, split), exist_ok=True)
+        with open(os.path.join(root, split, "all_data_0.json"), "w") as f:
+            json.dump(blob, f)
+    # provenance marker: later runs must never mistake this subset for
+    # the real MNIST archive (scripts/reproduce_baseline.py labels and
+    # baseline-comparability hang off this)
+    with open(os.path.join(root, "_source.json"), "w") as f:
+        json.dump(
+            {"source": "sklearn_digits", "real_data": True,
+             "is_mnist": False},
+            f,
+        )
+    logging.info(
+        "materialized real digits (sklearn) as LEAF %s: %d train users",
+        root, len(json.load(open(os.path.join(root, "train",
+                                              "all_data_0.json")))["users"]),
+    )
+    return root

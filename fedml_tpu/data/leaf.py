@@ -42,7 +42,11 @@ def read_leaf_dir(split_dir: str) -> Tuple[List[str], Dict[str, dict]]:
 def _to_arrays(entry: dict, feature_shape: Optional[Tuple[int, ...]]):
     x = np.asarray(entry["x"], dtype=np.float32)
     y = np.asarray(entry["y"])
-    if feature_shape is not None and x.ndim == 2:
+    if feature_shape is not None and len(x) == 0:
+        # an empty user entry parses as shape (0,) — give it the real
+        # feature shape or downstream concatenation dies
+        x = np.zeros((0,) + tuple(feature_shape), np.float32)
+    elif feature_shape is not None and x.ndim == 2:
         x = x.reshape((len(x),) + tuple(feature_shape))
     if y.dtype.kind in "fc":
         y = y.astype(np.int64)

@@ -149,6 +149,18 @@ def _try_load_federated(name: str, cache_dir: str, args=None):
     from . import ingest
     from .leaf import leaf_available, load_leaf
 
+    if (
+        name == "mnist"
+        and cache_dir
+        and not leaf_available(d)
+        and bool(getattr(args, "download", False))
+    ):
+        # reference parity: auto-fetch the MNIST LEAF archive
+        # (data/MNIST/data_loader.py:17-29) — with offline grace
+        from .download import download_mnist
+
+        download_mnist(cache_dir)
+
     out = None
     if leaf_available(d):
         if task == "nwp":

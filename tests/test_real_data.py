@@ -1,0 +1,146 @@
+"""Real-data path: download seam with offline grace + the bundled
+real-digits LEAF fixture and its learning trajectory (VERDICT r3 #2 —
+no "synthetic stand-in" anywhere in this path).
+"""
+
+import json
+import logging
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.data import load
+from fedml_tpu.data.download import download_mnist, materialize_real_digits
+from fedml_tpu.data.leaf import leaf_available
+from tests.conftest import make_args
+
+pytestmark = pytest.mark.smoke
+
+
+class TestDownloadSeam:
+    def test_offline_grace_returns_false(self, tmp_path):
+        # connection-refused fails fast; no exception escapes
+        ok = download_mnist(str(tmp_path), url="http://127.0.0.1:9/MNIST.zip")
+        assert ok is False
+
+    def test_file_url_download_extract_and_load(self, tmp_path):
+        # a real-format archive served via file:// exercises the whole
+        # seam (fetch -> extract -> MNIST/ -> mnist/ rename) offline
+        src = tmp_path / "src"
+        os.makedirs(src / "MNIST" / "train")
+        os.makedirs(src / "MNIST" / "test")
+        rng = np.random.RandomState(0)
+        for split, n in (("train", 20), ("test", 8)):
+            blob = {"users": ["u0", "u1"], "num_samples": [n, n], "user_data": {}}
+            for u in ("u0", "u1"):
+                blob["user_data"][u] = {
+                    "x": rng.rand(n, 784).round(3).tolist(),
+                    "y": rng.randint(0, 10, n).tolist(),
+                }
+            with open(src / "MNIST" / split / "all_data_0.json", "w") as f:
+                json.dump(blob, f)
+        zip_path = tmp_path / "archive.zip"
+        with zipfile.ZipFile(zip_path, "w") as zf:
+            for split in ("train", "test"):
+                zf.write(
+                    src / "MNIST" / split / "all_data_0.json",
+                    f"MNIST/{split}/all_data_0.json",
+                )
+        cache = tmp_path / "cache"
+        ok = download_mnist(str(cache), url=f"file://{zip_path}")
+        assert ok is True
+        assert leaf_available(str(cache / "mnist"))
+
+    def test_loader_attempts_download_only_when_asked(self, tmp_path, monkeypatch):
+        calls = []
+
+        def fake_download(cache_dir, url=None):
+            calls.append(cache_dir)
+            return False
+
+        import fedml_tpu.data.download as dl
+
+        monkeypatch.setattr(dl, "download_mnist", fake_download)
+        args = make_args(
+            dataset="mnist",
+            data_cache_dir=str(tmp_path),
+            client_num_in_total=2,
+            client_num_per_round=2,
+            synthetic_train_size=64,
+            synthetic_test_size=32,
+            model="lr",
+            batch_size=8,
+        )
+        load(args)
+        assert calls == []  # download defaults to off
+        args.download = True
+        load(args)
+        assert calls == [str(tmp_path)]
+
+
+class TestRealDigits:
+    def test_materialized_fixture_is_real_format(self, tmp_path):
+        root = materialize_real_digits(str(tmp_path), n_users=20, seed=1)
+        assert root is not None and leaf_available(root)
+        blob = json.load(open(os.path.join(root, "train", "all_data_0.json")))
+        assert set(blob) == {"users", "num_samples", "user_data"}
+        u0 = blob["user_data"][blob["users"][0]]
+        assert len(u0["x"][0]) == 784  # MNIST LEAF layout
+        assert blob["users"] == json.load(
+            open(os.path.join(root, "test", "all_data_0.json"))
+        )["users"]  # same user set in both splits (read_data assumption)
+
+    def test_single_sample_users_load(self, tmp_path):
+        # regression: a user with 1 sample writes an empty test entry
+        # ((0,)-shaped x) which used to crash np.concatenate in load()
+        materialize_real_digits(str(tmp_path), n_users=100, seed=1)
+        args = make_args(
+            dataset="mnist", data_cache_dir=str(tmp_path),
+            client_num_in_total=100, client_num_per_round=10,
+            model="lr", batch_size=10,
+        )
+        ds = load(args)
+        assert ds.client_num == 100
+
+    def test_subset_marker_written(self, tmp_path):
+        root = materialize_real_digits(str(tmp_path), n_users=10)
+        blob = json.load(open(os.path.join(root, "_source.json")))
+        assert blob["is_mnist"] is False and blob["real_data"] is True
+
+    def test_learning_trajectory_on_real_data(self, tmp_path, caplog):
+        """FedAvg+LR on the real digits climbs well past chance within
+        25 rounds, through the normal load() path, with NO synthetic
+        stand-in fallback."""
+        materialize_real_digits(str(tmp_path), n_users=20, seed=0)
+        args = make_args(
+            dataset="mnist",
+            data_cache_dir=str(tmp_path),
+            partition_method="hetero",
+            partition_alpha=0.5,
+            model="lr",
+            client_num_in_total=20,
+            client_num_per_round=10,
+            comm_round=25,
+            epochs=1,
+            batch_size=10,
+            learning_rate=0.03,
+            frequency_of_the_test=5,
+        )
+        from fedml_tpu import models
+        from fedml_tpu.simulation import FedAvgAPI
+
+        with caplog.at_level(logging.WARNING):
+            args = fedml_tpu.init(args)
+            dataset = load(args)
+        assert "synthetic stand-in" not in caplog.text
+        assert dataset.client_num == 20
+
+        model = models.create(args, dataset.class_num)
+        api = FedAvgAPI(args, None, dataset, model)
+        final = api.train()
+        accs = [h["test_acc"] for h in api.history]
+        assert final["test_acc"] > 0.6  # far past 10-class chance
+        assert accs[-1] > accs[0]  # genuinely learning
