@@ -20,17 +20,24 @@ Modes (inferred from the mesh axes):
   ``dp``, Megatron dense layout over ``tp`` (parallel/tensor.py),
   expert stacks over ``ep`` (parallel/expert.py). XLA SPMD inserts the
   collectives; numerics match the single-device program exactly.
-- **sequence** ({sp} alone): ring / Ulysses attention
+- **sequence** ({sp} or {dp, sp}): ring / Ulysses attention
   (parallel/sequence.py) with the token axis sharded over ``sp`` —
-  the long-context path. sp must divide the sequence length.
-- **pipeline** ({pp} alone): the block stack is cut into pp stages and
-  scheduled GPipe-style under shard_map (parallel/pipeline.py); the
-  batch is streamed as microbatches. ``num_layers % pp == 0``.
+  the long-context path; an optional ``dp`` axis shards the batch so
+  each replica runs its own sequence collectives. sp must divide the
+  sequence length, dp the batch size.
+- **pipeline** ({pp} or {dp, pp}): the block stack is cut into pp
+  stages and scheduled GPipe-style under shard_map
+  (parallel/pipeline.py); the batch is streamed as microbatches, and
+  an optional ``dp`` axis shards the examples within every microbatch
+  (each dp replica streams its slice through an identical pipeline).
+  ``num_layers % pp == 0``.
 
-Modes are exclusive by design: pp restructures the program (stage
-functions under shard_map) and the sp attention's shard_map specs pin
-every non-sequence axis unsharded, so composing them silently degrades
-to gathers — better to refuse loudly. dp x tp x ep compose freely.
+sp and pp each compose with dp (the batch axis rides untouched through
+their shard_maps) but remain exclusive with tp/ep and each other: pp
+restructures the program (stage functions under shard_map) and the sp
+attention's shard_map pins the head/model axes unsharded, so those
+combinations silently degrade to gathers — better to refuse loudly.
+dp x tp x ep compose freely.
 
 Training data: the dataset's global packed batches (``[nb, bs, T]``
 int tokens) — this is centralized mesh training, the "distributed"
@@ -69,11 +76,11 @@ def _resolve_mesh(args) -> Mesh:
         raise ValueError(
             f"mesh_shape axes {sorted(unknown)} unknown; pick from {sorted(_ALL_AXES)}"
         )
-    for bad in ("sp", "pp"):
-        if bad in shape and len(shape) > 1:
+    for special in ("sp", "pp"):
+        if special in shape and not set(shape) <= {special, "dp"}:
             raise ValueError(
-                f"mesh axis {bad!r} is exclusive (program structure differs); "
-                f"got {shape}"
+                f"mesh axis {special!r} composes only with 'dp' (its "
+                f"shard_map program pins the other axes); got {shape}"
             )
     n = int(np.prod(list(shape.values())))
     if n > len(devices):
@@ -170,6 +177,15 @@ class DistributedTrainer:
                 )
 
     # -- shared pieces -------------------------------------------------
+    def _check_dp_divides_batch(self) -> None:
+        """Every mode with a dp axis shards the batch over it."""
+        if "dp" not in self.mesh.axis_names:
+            return
+        bs = int(self.dataset.train_data_global.x.shape[1])
+        dp = self.mesh.shape["dp"]
+        if bs % dp:
+            raise ValueError(f"mesh axis dp={dp} must divide batch_size {bs}")
+
     def _loss(self, logits, y, mask):
         loss, metrics = self.model.loss_fn(logits.astype(jnp.float32), y, mask)
         return loss, metrics
@@ -291,13 +307,7 @@ class DistributedTrainer:
 
     # -- sharded: dp x tp x ep ----------------------------------------
     def _build_sharded(self, init_rng) -> None:
-        if "dp" in self.mesh.axis_names:
-            bs = int(self.dataset.train_data_global.x.shape[1])
-            dp = self.mesh.shape["dp"]
-            if bs % dp:
-                raise ValueError(
-                    f"mesh axis dp={dp} must divide batch_size {bs}"
-                )
+        self._check_dp_divides_batch()
         params = self.model.init(init_rng)
         self.params = shard_params_tp_ep(params, self.mesh)
         self.opt_state = self.optimizer.init(self.params)
@@ -323,34 +333,47 @@ class DistributedTrainer:
                 "sequence parallelism needs the transformer family"
             )
         sp = self.mesh.shape["sp"]
+        has_dp = "dp" in self.mesh.axis_names
         strategy = str(getattr(self.args, "sp_strategy", "ring") or "ring")
         attn = make_sequence_sharded_attention(
-            self.mesh, strategy=strategy, causal=True
+            self.mesh, strategy=strategy, causal=True,
+            batch_axis="dp" if has_dp else None,
         )
         sp_module = module.clone(attn_fn=attn)
         self.model = dataclasses.replace(self.model, module=sp_module)
         seq_len = int(self.dataset.train_data_global.x.shape[-1])
         if seq_len % sp:
             raise ValueError(f"mesh axis sp={sp} must divide seq_len {seq_len}")
+        self._check_dp_divides_batch()
+        # example batch = dp size: the attention shard_map inside the
+        # module requires the batch axis divisible by dp even at init
         params = self.model.init(
             init_rng,
-            example_x=jnp.zeros((1, seq_len), jnp.int32),
+            example_x=jnp.zeros(
+                (self.mesh.shape.get("dp", 1), seq_len), jnp.int32
+            ),
         )
         from .parallel.mesh import replicate
 
         self.params = replicate(params, self.mesh)
         self.opt_state = self.optimizer.init(self.params)
-        # x/y [nb, bs, T]: token axis over sp; the per-example mask
-        # [nb, bs] (and any rank<3 leaf) stays replicated — the
-        # attention shard_map pins non-sequence axes anyway
+        # x/y [nb, bs, T]: token axis over sp, batch over dp when
+        # present; the per-example mask [nb, bs] (and any rank<3 leaf)
+        # shards over dp only — the attention shard_map pins the
+        # head/model axes anyway
         from .parallel.mesh import place_global
+
+        batch = "dp" if has_dp else None
 
         def place(b):
             return jax.tree.map(
                 lambda a: place_global(
                     a,
                     NamedSharding(
-                        self.mesh, P(None, None, "sp") if a.ndim >= 3 else P()
+                        self.mesh,
+                        P(None, batch, "sp") if a.ndim >= 3
+                        else P(None, batch) if a.ndim == 2
+                        else P(),
                     ),
                 ),
                 b,
@@ -405,8 +428,19 @@ class DistributedTrainer:
         self.opt_state = self.optimizer.init(self.params)
         from .parallel.mesh import place_global
 
+        has_dp = "dp" in self.mesh.axis_names
+        self._check_dp_divides_batch()
+        # batch axis (leaf axis 1: [nb, bs, ...]) over dp when present;
+        # the pipeline shard_map streams each dp slice independently
         self._place_data = lambda b: jax.tree.map(
-            lambda a: place_global(a, NamedSharding(self.mesh, P())), b
+            lambda a: place_global(
+                a,
+                NamedSharding(
+                    self.mesh,
+                    P(None, "dp") if has_dp and a.ndim >= 2 else P(),
+                ),
+            ),
+            b,
         )
         self._epoch = jax.jit(
             self._epoch_scanner(
@@ -453,13 +487,16 @@ class DistributedTrainer:
             h, _ = jax.lax.scan(one_block, h, stage_params)
             return h
 
+        dp = self.mesh.shape.get("dp", 1)
         micro = int(getattr(self.args, "pp_microbatches", 0) or 0)
         if micro <= 0:
-            micro = min(B, max(2 * self.mesh.shape["pp"], 1))
-            while B % micro:
+            # microbatch size must also split across the dp replicas
+            micro = min(B // dp if B >= dp else B, max(2 * self.mesh.shape["pp"], 1))
+            while micro > 1 and (B % micro or (B // micro) % dp):
                 micro -= 1
         out = pipeline_apply(
-            stage_fn, stages, split_microbatches(x, micro), self.mesh
+            stage_fn, stages, split_microbatches(x, micro), self.mesh,
+            batch_axis="dp" if dp > 1 else None,
         )
         x = out.reshape(B, T, -1)
         x = nn.LayerNorm().apply({"params": outer["LayerNorm_0"]}, x)

@@ -53,6 +53,7 @@ def pipeline_apply(
     x: jax.Array,
     mesh: Mesh,
     axis: str = "pp",
+    batch_axis: str = None,
 ) -> jax.Array:
     """Run ``y_i = stage_{S-1}(... stage_0(x_i))`` for microbatches
     ``x: [M, mb, ...]`` on an ``S``-stage pipeline; returns [M, mb, ...].
@@ -60,18 +61,31 @@ def pipeline_apply(
     ``stage_params`` leaves have leading axis S == mesh.shape[axis];
     ``stage_fn(params_s, h) -> h`` must preserve the activation shape
     (uniform stages — the transformer-block case).
+
+    ``batch_axis`` composes data parallelism with the pipeline: the
+    microbatch examples axis (``x`` axis 1) is sharded over that mesh
+    axis, so each dp replica streams its own slice through an identical
+    pipeline (stage weights replicated across dp — the spec simply
+    doesn't mention it); gradient reduction across dp belongs to the
+    caller's jit (XLA SPMD inserts it).
     """
     S = mesh.shape[axis]
     M = x.shape[0]
     leading = jax.tree.leaves(stage_params)[0].shape[0]
     if leading != S:
         raise ValueError(f"stage_params leading axis {leading} != pp axis {S}")
+    if batch_axis is not None and x.shape[1] % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"batch_axis {batch_axis}={mesh.shape[batch_axis]} must divide "
+            f"microbatch size {x.shape[1]}"
+        )
+    x_spec = P(None, batch_axis) if batch_axis else P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
     )
     def run(params, x):
         params = jax.tree.map(lambda a: a[0], params)  # this device's stage

@@ -141,11 +141,17 @@ def ulysses_attention(
 
 
 def make_sequence_sharded_attention(
-    mesh, strategy: str = "ring", causal: bool = True, axis_name: str = "sp"
+    mesh, strategy: str = "ring", causal: bool = True, axis_name: str = "sp",
+    batch_axis: str = None,
 ):
     """Wrap a strategy as a [B, T, H, D] -> [B, T, H, D] function whose
     sequence axis is sharded over ``mesh[axis_name]`` via shard_map —
-    drop-in for dense attention inside a pjit'ed training step."""
+    drop-in for dense attention inside a pjit'ed training step.
+
+    ``batch_axis`` composes data parallelism: the batch axis is sharded
+    over that mesh axis (each dp replica runs its own ring/all-to-all
+    over the sp axis; without it, a multi-axis mesh would gather the
+    dp-sharded batch at the shard_map boundary)."""
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -156,7 +162,7 @@ def make_sequence_sharded_attention(
         )
     fn = strategies[strategy]
     inner = functools.partial(fn, axis_name=axis_name, causal=causal)
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
 
     return shard_map(
         inner,

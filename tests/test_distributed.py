@@ -76,11 +76,25 @@ class TestMeshResolution:
         with pytest.raises(ValueError, match="unknown"):
             _resolve_mesh(_args(args_factory, mesh_shape={"zz": 8}))
 
-    def test_exclusive_axes_rejected(self, args_factory):
-        with pytest.raises(ValueError, match="exclusive"):
-            _resolve_mesh(_args(args_factory, mesh_shape={"pp": 4, "dp": 2}))
-        with pytest.raises(ValueError, match="exclusive"):
+    def test_sp_pp_compose_only_with_dp(self, args_factory):
+        # dp x pp and dp x sp are valid meshes now
+        assert dict(
+            _resolve_mesh(
+                _args(args_factory, mesh_shape={"dp": 2, "pp": 4})
+            ).shape
+        ) == {"dp": 2, "pp": 4}
+        assert dict(
+            _resolve_mesh(
+                _args(args_factory, mesh_shape={"dp": 2, "sp": 4})
+            ).shape
+        ) == {"dp": 2, "sp": 4}
+        # but tp/ep and sp+pp still refuse
+        with pytest.raises(ValueError, match="composes only with 'dp'"):
             _resolve_mesh(_args(args_factory, mesh_shape={"sp": 4, "tp": 2}))
+        with pytest.raises(ValueError, match="composes only with 'dp'"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"sp": 2, "pp": 4}))
+        with pytest.raises(ValueError, match="composes only with 'dp'"):
+            _resolve_mesh(_args(args_factory, mesh_shape={"pp": 4, "ep": 2}))
 
     def test_too_many_devices_rejected(self, args_factory):
         with pytest.raises(ValueError, match="devices"):
@@ -152,7 +166,7 @@ class TestModes:
         np.testing.assert_allclose(sp["test_acc"], dense["test_acc"], atol=0.05)
 
     def test_bad_sp_strategy_rejected(self, args_factory):
-        with pytest.raises(KeyError, match="bogus"):
+        with pytest.raises(ValueError, match="bogus"):
             _run(args_factory, mesh_shape={"sp": 4}, sp_strategy="bogus")
 
     def test_pipeline(self, args_factory):
@@ -165,6 +179,37 @@ class TestModes:
         # learned from the ~4.5 random-init loss.
         np.testing.assert_allclose(pp["train_loss"], seq["train_loss"], rtol=0.15)
         assert pp["train_loss"] < 1.5 and seq["train_loss"] < 1.5
+
+    def test_dp_sp_composition(self, args_factory):
+        """Batch over dp x tokens over sp: each dp replica runs its own
+        ring collectives; numerics track the single-device program."""
+        dense = _dense_baseline(args_factory)
+        trainer, dpsp = _run(args_factory, mesh_shape={"dp": 2, "sp": 4})
+        assert trainer.mode == "sequence"
+        x = trainer._place_data(trainer.dataset.train_data_global).x
+        # data genuinely sharded on both axes
+        assert x.addressable_shards[0].data.shape[1] == x.shape[1] // 2
+        assert x.addressable_shards[0].data.shape[2] == x.shape[2] // 4
+        np.testing.assert_allclose(
+            dpsp["train_loss"], dense["train_loss"], rtol=5e-2
+        )
+        np.testing.assert_allclose(
+            dpsp["test_acc"], dense["test_acc"], atol=0.05
+        )
+
+    def test_dp_pp_composition(self, args_factory):
+        """GPipe microbatching inside each dp replica."""
+        _, seq = _run(args_factory, num_layers=4, mesh_shape={"dp": 1})
+        trainer, dppp = _run(
+            args_factory, num_layers=4, mesh_shape={"dp": 2, "pp": 4}
+        )
+        assert trainer.mode == "pipeline"
+        x = trainer._place_data(trainer.dataset.train_data_global).x
+        assert x.addressable_shards[0].data.shape[1] == x.shape[1] // 2
+        np.testing.assert_allclose(
+            dppp["train_loss"], seq["train_loss"], rtol=0.15
+        )
+        assert dppp["train_loss"] < 1.5 and seq["train_loss"] < 1.5
 
     def test_pipeline_layer_mismatch_rejected(self, args_factory):
         with pytest.raises(ValueError, match="num_layers"):
