@@ -88,7 +88,6 @@ _DEFAULTS: Dict[str, Any] = {
     # device
     "using_gpu": True,
     "device_type": "tpu",
-    "mesh_shape": None,  # e.g. {"clients": 8} or {"clients": 4, "data": 2}
     "gpu_mapping_file": None,
     # comm
     "grpc_ipconfig_path": None,

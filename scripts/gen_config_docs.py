@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Generate docs/configuration.md from arguments._DEFAULTS.
+
+The ``_DEFAULTS`` table in ``fedml_tpu/arguments.py`` is the de-facto
+YAML schema (every knob, its default, and a source comment explaining
+it). This script turns it into the user-facing reference page so the
+docs can never drift from the code: ``tests/test_docs.py`` regenerates
+the page and asserts it matches the checked-in copy.
+
+Usage: python scripts/gen_config_docs.py [--check]
+"""
+
+import argparse
+import ast
+import io
+import os
+import sys
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+SRC = os.path.join(REPO, "fedml_tpu", "arguments.py")
+OUT = os.path.join(REPO, "docs", "configuration.md")
+
+HEADER = """\
+# Configuration reference
+
+<!-- GENERATED FILE — edit fedml_tpu/arguments.py and run
+     `python scripts/gen_config_docs.py` to refresh. -->
+
+Every run is configured by a sectioned YAML file passed as `--cf
+<path>` (reference-parity CLI). Sections (`common_args`, `data_args`,
+`model_args`, `train_args`, `validation_args`, `device_args`,
+`comm_args`, `tracking_args`, ...) are flattened into one attribute
+namespace, so a knob may live in whichever section reads best — the
+tables below group them by convention.
+
+A minimal config:
+
+```yaml
+common_args: {training_type: simulation, random_seed: 0}
+data_args:   {dataset: mnist, partition_method: hetero, partition_alpha: 0.5}
+model_args:  {model: lr}
+train_args:
+  federated_optimizer: FedAvg
+  client_num_in_total: 1000
+  client_num_per_round: 10
+  comm_round: 200
+  epochs: 1
+  batch_size: 10
+  learning_rate: 0.03
+```
+
+Unset knobs take the defaults below (`fedml_tpu/arguments.py`
+`_DEFAULTS` — the authoritative schema this page is generated from).
+
+"""
+
+
+def extract_entries():
+    """(key, default_repr, comment) per _DEFAULTS entry, in order."""
+    with open(SRC) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    assign = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.AnnAssign)
+        and getattr(n.target, "id", None) == "_DEFAULTS"
+    )
+    # comments by line number
+    comments = {}
+    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+        if tok.type == tokenize.COMMENT:
+            comments[tok.start[0]] = tok.string.lstrip("# ").rstrip()
+
+    from fedml_tpu import constants
+
+    entries = []
+    for key_node, val_node in zip(assign.value.keys, assign.value.values):
+        # block comment: contiguous comment lines directly above the key
+        block, line = [], key_node.lineno - 1
+        while line in comments:
+            block.insert(0, comments[line])
+            line -= 1
+        # single-word section markers ("# data") are layout, not docs
+        if len(block) == 1 and len(block[0].split()) == 1:
+            block = []
+        # inline comment on the value's own line(s)
+        inline = comments.get(val_node.end_lineno)
+        if inline and val_node.end_lineno > key_node.lineno - 1:
+            block.append(inline)
+        default = eval(  # noqa: S307 — our own source, constants only
+            ast.unparse(val_node), {"constants": constants}
+        )
+        entries.append(
+            (ast.literal_eval(key_node), repr(default), " ".join(block))
+        )
+    return entries
+
+
+# hand-maintained meanings for knobs whose source comment is elsewhere
+# (docstrings, reference parity docs); generator output falls back here
+SUPPLEMENT = {
+    "training_type": "`simulation` | `cross_silo` | `cross_device` | `distributed`",
+    "backend": "simulation engine: `single_process` (SP) or `MESH` "
+               "(cohort sharded over a device mesh); cross-silo: "
+               "`LOCAL` | `GRPC` | `MQTT`",
+    "scenario": "cross-silo topology: `horizontal` or `hierarchical`",
+    "random_seed": "seed for sampling/partition/init determinism",
+    "dataset": "dataset name (see docs/datasets.md); real on-disk copies "
+               "under `data_cache_dir/<name>` are used when present, else "
+               "a synthetic stand-in with identical shapes",
+    "data_cache_dir": "root directory for on-disk datasets",
+    "partition_method": "`hetero` (Dirichlet LDA over labels) or `homo`",
+    "partition_alpha": "LDA concentration (lower = more non-IID)",
+    "model": "model zoo key (see docs/models.md), e.g. `lr`, `cnn`, "
+             "`resnet18`, `transformer`, `moe_transformer`",
+    "federated_optimizer": "`FedAvg` | `FedProx` | `FedOpt` | `FedNova` | "
+                           "`HierFedAvg` | `DSGD` | `PushSum` | ... "
+                           "(simulation/fedavg_api.py registry)",
+    "client_id_list": "explicit client ids for cross-silo processes "
+                      "(reference parity); None = ranks 1..N",
+    "client_num_in_total": "federation size",
+    "client_num_per_round": "sampled cohort per round",
+    "comm_round": "federation rounds",
+    "epochs": "local epochs per round (or total epochs, distributed)",
+    "batch_size": "per-client batch size",
+    "client_optimizer": "`sgd` | `adam` | `adamw`",
+    "learning_rate": "client LR (peak when a schedule is set)",
+    "momentum": "client SGD momentum",
+    "weight_decay": "client weight decay",
+    "server_optimizer": "FedOpt server rule: `sgd` | `adam` | `adagrad` | `yogi`",
+    "server_lr": "FedOpt server LR",
+    "server_momentum": "FedOpt server momentum",
+    "fedprox_mu": "FedProx proximal weight",
+    "frequency_of_the_test": "evaluate every N rounds/epochs",
+    "enable_tracking": "enable the metrics sink fan-out",
+    "run_id": "run identifier for logging/tracking",
+    "profile_dir": "write an XLA device trace here (tensorboard/perfetto)",
+    "using_gpu": "reference-parity flag (accelerator use)",
+    "device_type": "reference-parity device label",
+    "gpu_mapping_file": "reference-parity cluster mapping file (unused on TPU)",
+    "grpc_ipconfig_path": "CSV of rank->ip for the gRPC fabric",
+    "grpc_port_base": "first gRPC port (rank k listens on base+k)",
+    "defense_type": "robust aggregation: `norm_clip` | `weak_dp` | "
+                    "`coord_median` (core/aggregation.py)",
+    "norm_bound": "update norm clip bound (norm_clip / weak_dp)",
+    "stddev": "weak-DP noise stddev",
+    "matmul_precision": "jax matmul precision (`highest` for oracle "
+                        "equivalence tests; `default` for speed)",
+    "mesh_shape": "mesh axes -> sizes; simulation MESH: `{clients, data}`; "
+                  "distributed: `{dp,tp,ep}` | `{dp,sp}` | `{dp,pp}`",
+    "sp_strategy": "sequence parallelism: `ring` or `ulysses`",
+}
+
+
+# display grouping: key -> section heading (defaults to "Other")
+GROUPS = [
+    ("Platform", ["training_type", "backend", "scenario", "random_seed"]),
+    ("Data", [
+        "dataset", "data_cache_dir", "partition_method", "partition_alpha",
+        "packing_waste_cap", "image_size", "download",
+    ]),
+    ("Model", ["model", "dtype", "remat"]),
+    ("Federated training", [
+        "federated_optimizer", "client_id_list", "client_num_in_total",
+        "client_num_per_round", "comm_round", "epochs", "batch_size",
+        "client_optimizer", "learning_rate", "momentum", "weight_decay",
+        "server_optimizer", "server_lr", "server_momentum", "fedprox_mu",
+    ]),
+    ("LR schedule", [
+        "lr_schedule", "lr_total_steps", "warmup_steps", "lr_total_rounds",
+        "warmup_rounds",
+    ]),
+    ("Cross-silo robustness & comms", [
+        "aggregation_deadline_s", "aggregation_deadline_max_extensions",
+        "compression", "compression_topk_ratio", "elastic_membership",
+        "grpc_ipconfig_path", "grpc_port_base", "fault_injection",
+    ]),
+    ("Defense", ["defense_type", "norm_bound", "stddev"]),
+    ("Parallelism (mesh / distributed)", [
+        "mesh_shape", "sp_strategy", "pp_microbatches", "moe_aux_weight",
+        "grad_accum_steps", "matmul_precision",
+    ]),
+    ("Device", ["using_gpu", "device_type", "gpu_mapping_file"]),
+    ("Validation & tracking", [
+        "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
+    ]),
+]
+
+
+def render(entries) -> str:
+    by_key = {k: (d, c) for k, d, c in entries}
+    out = [HEADER]
+    seen = set()
+    for title, keys in GROUPS:
+        rows = [k for k in keys if k in by_key]
+        if not rows:
+            continue
+        out.append(f"## {title}\n\n")
+        out.append("| knob | default | meaning |\n|---|---|---|\n")
+        for k in rows:
+            d, c = by_key[k]
+            c = (c or SUPPLEMENT.get(k, "")).replace("|", "\\|")
+            out.append(f"| `{k}` | `{d}` | {c} |\n")
+            seen.add(k)
+        out.append("\n")
+    rest = [k for k, _, _ in entries if k not in seen]
+    if rest:
+        out.append("## Other\n\n| knob | default | meaning |\n|---|---|---|\n")
+        for k in rest:
+            d, c = by_key[k]
+            c = (c or SUPPLEMENT.get(k, "")).replace("|", "\\|")
+            out.append(f"| `{k}` | `{d}` | {c} |\n")
+        out.append("\n")
+    return "".join(out)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 if docs/configuration.md is stale",
+    )
+    a = p.parse_args()
+    text = render(extract_entries())
+    if a.check:
+        with open(OUT) as f:
+            current = f.read()
+        if current != text:
+            print("docs/configuration.md is stale; rerun scripts/gen_config_docs.py")
+            return 1
+        print("docs/configuration.md is fresh")
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
