@@ -20,12 +20,22 @@ import numpy as np
 
 class RoundCheckpointer:
     """Saves {params, server_state, rng, round_idx} every
-    ``checkpoint_freq`` rounds under ``checkpoint_dir``."""
+    ``checkpoint_freq`` rounds under ``checkpoint_dir``.
 
-    def __init__(self, checkpoint_dir: str, keep: int = 3) -> None:
+    ``multihost=True`` is the multi-controller mode: state leaves stay
+    ``jax.Array``s (possibly not fully addressable — each process holds
+    only its shards) and orbax writes/reads them collectively, so
+    ``save``/``restore`` MUST be called by every process. The dir must
+    be on a filesystem all processes share.
+    """
+
+    def __init__(
+        self, checkpoint_dir: str, keep: int = 3, multihost: bool = False
+    ) -> None:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self.multihost = bool(multihost)
         self.dir = os.path.abspath(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.manager = ocp.CheckpointManager(
@@ -34,9 +44,12 @@ class RoundCheckpointer:
         )
 
     def save(self, round_idx: int, state: Dict[str, Any]) -> None:
-        host_state = jax.tree.map(np.asarray, state)
+        if not self.multihost:
+            # single-controller: host copies decouple the checkpoint
+            # from donated device buffers
+            state = jax.tree.map(np.asarray, state)
         self.manager.save(
-            round_idx, args=self._ocp.args.StandardSave(host_state)
+            round_idx, args=self._ocp.args.StandardSave(state)
         )
         self.manager.wait_until_finished()
         logging.info("checkpoint saved at round %d -> %s", round_idx, self.dir)
@@ -44,11 +57,36 @@ class RoundCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
-    def restore(self, round_idx: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    def restore(
+        self,
+        round_idx: Optional[int] = None,
+        target: Optional[Any] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Latest (or ``round_idx``) state, or None when none exists.
+
+        With ``target`` (a pytree of arrays/ShapeDtypeStructs carrying
+        shardings), leaves are restored directly onto those shardings —
+        the multi-controller path, where each process reads only its
+        shards; also valid single-controller (restores placed arrays).
+        """
         step = round_idx if round_idx is not None else self.latest_step()
         if step is None:
             return None
-        state = self.manager.restore(step)
+        if target is not None:
+
+            def to_ref(a):
+                if hasattr(a, "dtype") and hasattr(a, "shape"):
+                    return jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=getattr(a, "sharding", None)
+                    )
+                return a  # plain python scalars (epoch counter)
+
+            state = self.manager.restore(
+                step,
+                args=self._ocp.args.StandardRestore(jax.tree.map(to_ref, target)),
+            )
+        else:
+            state = self.manager.restore(step)
         logging.info("checkpoint restored from round %d", step)
         return state
 

@@ -88,7 +88,7 @@ def _resolve_mesh(args) -> Mesh:
     if jax.process_count() > 1 and n != len(devices):
         # a device subset could exclude every addressable device of
         # some process, which then holds no shard of anything — refuse
-        # loudly (same policy as the multi-controller checkpoint guard)
+        # loudly
         raise ValueError(
             f"multi-controller run ({jax.process_count()} processes): "
             f"mesh_shape {shape} must span all {len(devices)} global "
@@ -124,53 +124,53 @@ class DistributedTrainer:
         # checkpoint/resume (core/checkpoint.py): save {params,
         # opt_state, epoch}; a restarted process resumes mid-training
         # with the restored leaves placed back onto this mode's
-        # shardings (the checkpoint itself is host arrays)
+        # shardings. Single-controller saves host copies; under
+        # multi-controller the leaves stay (possibly non-addressable)
+        # jax.Arrays and orbax writes/reads each process's shards
+        # collectively
         self._ckpt = None
         self._start_epoch = 0
         ckpt_dir = getattr(args, "checkpoint_dir", None)
         if ckpt_dir:
+            from flax.serialization import from_state_dict, to_state_dict
+
+            from .core.checkpoint import RoundCheckpointer
             from .parallel.mesh import is_multi_controller
 
-            if is_multi_controller(self.mesh):
-                # np.asarray on non-fully-addressable arrays would
-                # crash mid-save; refuse up front instead
-                raise ValueError(
-                    "checkpoint_dir is not supported in multi-controller "
-                    "runs yet — each process only holds its shards"
-                )
-            from .core.checkpoint import RoundCheckpointer
-
-            self._ckpt = RoundCheckpointer(ckpt_dir)
+            multihost = is_multi_controller(self.mesh)
+            self._ckpt = RoundCheckpointer(ckpt_dir, multihost=multihost)
             self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
-            state = self._ckpt.restore()
+
+            def norm_sharding(c):
+                # mesh-placed leaves keep their layout; leaves optax
+                # created fresh (adam's scalar count has a single-device
+                # sharding) go in replicated — committing them to one
+                # device would conflict with the mesh-sharded params
+                # under jit
+                s = c.sharding if isinstance(
+                    c.sharding, NamedSharding
+                ) else NamedSharding(self.mesh, P())
+                return jax.ShapeDtypeStruct(c.shape, c.dtype, sharding=s)
+
+            # sharding-targeted restore: leaves land directly on this
+            # mode's mesh layout. Under multi-controller every process
+            # participates and reads only its shards (orbax collective)
+            # — the state-dict view keeps optax namedtuple fields
+            # name-paired, not positionally zipped.
+            target = {
+                "params": jax.tree.map(norm_sharding, self.params),
+                "opt_state": jax.tree.map(
+                    norm_sharding, to_state_dict(self.opt_state)
+                ),
+                "epoch": 0,
+            }
+            state = self._ckpt.restore(target=target)
             if state is not None:
-                from flax.serialization import from_state_dict
-
                 self._start_epoch = int(state["epoch"]) + 1
-
-                def put_tree(cur_tree, new_tree):
-                    # name-based pairing (same pattern as fedavg_api's
-                    # _maybe_restore): orbax restores namedtuple optax
-                    # states as dicts whose flatten order can differ
-                    # from field order — positional zip would silently
-                    # swap same-shaped leaves (adam's mu/nu)
-                    restored = from_state_dict(cur_tree, new_tree)
-
-                    def put(c, n):
-                        # mesh-placed leaves keep their layout; leaves
-                        # optax created fresh (adam's scalar count has
-                        # a single-device sharding) go in replicated —
-                        # committing them to one device would conflict
-                        # with the mesh-sharded params under jit
-                        s = c.sharding if isinstance(
-                            c.sharding, NamedSharding
-                        ) else NamedSharding(self.mesh, P())
-                        return jax.device_put(jnp.asarray(n), s)
-
-                    return jax.tree.map(put, cur_tree, restored)
-
-                self.params = put_tree(self.params, state["params"])
-                self.opt_state = put_tree(self.opt_state, state["opt_state"])
+                self.params = state["params"]
+                self.opt_state = from_state_dict(
+                    self.opt_state, state["opt_state"]
+                )
                 logging.info(
                     "distributed trainer resumed at epoch %d from %s",
                     self._start_epoch, ckpt_dir,

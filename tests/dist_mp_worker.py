@@ -8,6 +8,7 @@ cross-process collectives. Spawned by
 """
 
 import argparse
+import os
 import sys
 
 
@@ -17,6 +18,12 @@ def main() -> int:
     p.add_argument("--n_proc", type=int, required=True)
     p.add_argument("--coordinator", required=True)
     p.add_argument("--out", default="")
+    p.add_argument("--ckpt_dir", default="")
+    p.add_argument("--epochs", type=int, default=2)
+    # crash simulation for the resume test: hard-exit every process
+    # right after the checkpoint of this epoch lands (the point any
+    # crash-consistent resume has to restart from)
+    p.add_argument("--die_after_epoch", type=int, default=-1)
     ns = p.parse_args()
 
     import jax
@@ -52,7 +59,7 @@ def main() -> int:
         client_num_in_total=1,
         client_num_per_round=1,
         comm_round=1,
-        epochs=2,
+        epochs=ns.epochs,
         batch_size=8,
         learning_rate=0.1,
         frequency_of_the_test=1,
@@ -60,12 +67,26 @@ def main() -> int:
         run_id=f"dist_mp_{ns.proc_rank}",
     ).items():
         setattr(args, k, v)
+    if ns.ckpt_dir:
+        args.checkpoint_dir = ns.ckpt_dir
+        args.checkpoint_freq = 1
     args._validate()
     args = fedml_tpu.init(args)
     dataset = load(args)
     model = models.create(args, dataset.class_num)
     trainer = DistributedTrainer(args, None, dataset, model)
     assert is_multi_controller(trainer.mesh)
+    if ns.die_after_epoch >= 0:
+        assert trainer._ckpt is not None
+        orig_save = trainer._ckpt.save
+
+        def save_then_maybe_die(ep, state):
+            orig_save(ep, state)
+            if ep >= ns.die_after_epoch:
+                print("DIST_WORKER_DYING", ns.proc_rank, flush=True)
+                os._exit(3)
+
+        trainer._ckpt.save = save_then_maybe_die
     stats = trainer.run()
 
     if ns.proc_rank == 0 and ns.out:
@@ -75,6 +96,7 @@ def main() -> int:
             for i, x in enumerate(jax.tree.leaves(trainer.params))
         }
         flat["train_loss"] = np.float64(stats["train_loss"])
+        flat["start_epoch"] = np.float64(trainer._start_epoch)
         np.savez(ns.out, **flat)
     print("DIST_WORKER_DONE", ns.proc_rank, flush=True)
     return 0

@@ -170,7 +170,7 @@ class TestModes:
             _run(args_factory, mesh_shape={"sp": 4}, sp_strategy="bogus")
 
     def test_pipeline(self, args_factory):
-        _, seq = _run(args_factory, num_layers=4, mesh_shape={"dp": 1})
+        seq = _dense_baseline(args_factory, num_layers=4)
         trainer, pp = _run(args_factory, num_layers=4, mesh_shape={"pp": 4})
         assert trainer.mode == "pipeline"
         # trajectory tolerance (loose: ~16 sgd steps at lr .1 amplify
@@ -199,7 +199,7 @@ class TestModes:
 
     def test_dp_pp_composition(self, args_factory):
         """GPipe microbatching inside each dp replica."""
-        _, seq = _run(args_factory, num_layers=4, mesh_shape={"dp": 1})
+        seq = _dense_baseline(args_factory, num_layers=4)
         trainer, dppp = _run(
             args_factory, num_layers=4, mesh_shape={"dp": 2, "pp": 4}
         )
