@@ -124,10 +124,12 @@ class TestMessage:
 
 @pytest.mark.smoke
 class TestCrossSiloLocal:
+    @pytest.mark.slow
     def test_round_loop_completes(self, args_factory):
         server = _run_world(args_factory, run_id="cs1", backend="LOCAL")
         assert server.manager.round_idx == 3
 
+    @pytest.mark.slow
     def test_client_id_list_indirection(self, args_factory):
         """Real edge-device ids (not 1..N ranks) flow through selection
         and reporting while transport stays rank-addressed
@@ -150,6 +152,7 @@ class TestCrossSiloLocal:
         with pytest.raises(ValueError, match="client_id_list"):
             _resolve_client_real_ids(a, size=5)
 
+    @pytest.mark.slow
     def test_matches_single_process_simulation(self, args_factory):
         server = _run_world(args_factory, run_id="cs2", backend="LOCAL")
 

@@ -339,6 +339,7 @@ def _write_png(path, rng, hw=(48, 40)):
 
 
 class TestImageFolder:
+    @pytest.mark.slow
     def test_imagenet_style_folder(self, tmp_path, args_factory):
         rng = np.random.RandomState(0)
         d = tmp_path / "imagenet"
@@ -438,6 +439,7 @@ class TestVflPartyCsv:
         assert [f.shape[1] for f in feats] == [2, 3, 1]
         np.testing.assert_array_equal(labels, y)
 
+    @pytest.mark.slow
     def test_vfl_api_consumes_party_csvs(self, tmp_path, args_factory):
         """The NORMAL entry path: load(args) detects the party CSVs for
         any dataset name and the VFL engine uses the real per-party

@@ -111,6 +111,7 @@ class TestFederatedEqualsCentralized:
 
 
 class TestBackendEquivalence:
+    @pytest.mark.slow
     def test_vectorized_equals_sequential(self, args_factory):
         results = {}
         for mode in ("vectorized", "sequential"):

@@ -100,6 +100,7 @@ class TestComputeDtype:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow
     def test_simulation_runs_under_bf16(self, args_factory):
         args = args_factory(
             training_type="simulation",

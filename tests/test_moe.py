@@ -45,6 +45,7 @@ class TestSwitchFFN:
         expected = (h @ p["wo"][0] + p["bo"][0]).reshape(B, T, C)
         np.testing.assert_allclose(np.asarray(y), np.asarray(expected), atol=1e-5)
 
+    @pytest.mark.slow
     def test_full_capacity_routing_matches_manual(self):
         E = 4
         m = SwitchFFN(num_experts=E, capacity_factor=float(E), mlp_ratio=2)
@@ -59,6 +60,7 @@ class TestSwitchFFN:
             expected = float(probs[n, e]) * (h @ params["wo"][e] + params["bo"][e])
             np.testing.assert_allclose(y[n], np.asarray(expected), atol=1e-4)
 
+    @pytest.mark.slow
     def test_overflow_tokens_dropped(self):
         # capacity 1 with every token routed to the same expert: only
         # the first token per expert produces output, the rest fall
@@ -71,6 +73,7 @@ class TestSwitchFFN:
         nonzero = np.abs(y).sum(-1) > 1e-9
         assert nonzero.sum() <= E  # capacity 1 per expert
 
+    @pytest.mark.slow
     def test_bf16_dispatch_exact_past_256_tokens_per_expert(self):
         # routing math must run in f32/int32 regardless of compute
         # dtype: bf16 only represents integers exactly up to 256, so a
@@ -114,6 +117,7 @@ class TestExpertParallel:
         params = model.init(jax.random.PRNGKey(0), tokens)["params"]
         return model, params, tokens
 
+    @pytest.mark.slow
     def test_specs_target_expert_stacks_only(self):
         _, params, _ = self._model_and_batch()
         specs = ep_specs(params)
@@ -123,6 +127,7 @@ class TestExpertParallel:
         assert moe["router"]["kernel"] == P()
         assert specs["Block_0"]["Dense_0"]["kernel"] == P()
 
+    @pytest.mark.slow
     def test_ep_sharded_step_matches_replicated(self):
         model, params, tokens = self._model_and_batch()
         opt = optax.sgd(0.1)
@@ -157,6 +162,7 @@ class TestExpertParallel:
             out_params, ref_params,
         )
 
+    @pytest.mark.slow
     def test_tp_ep_composition(self):
         """One merged layout: dense layers on tp, expert stacks on ep."""
         _, params, tokens = self._model_and_batch()

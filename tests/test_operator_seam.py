@@ -238,6 +238,7 @@ class TestCrossSiloSeam:
         init_params = model.init(jax.random.split(jax.random.PRNGKey(0))[1])
         assert _params_equal(init_params, server.aggregator.get_global_model_params())
 
+    @pytest.mark.slow
     def test_custom_trainer_matches_simulation(self, args_factory):
         """Same custom operator, two backends, same numbers — the seam
         composes with the transport the way the stock engine does."""

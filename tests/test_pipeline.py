@@ -43,6 +43,7 @@ def _stages_and_input(seed=0):
 
 
 class TestPipeline:
+    @pytest.mark.slow
     def test_forward_matches_sequential(self):
         stage_fn, per_stage, x = _stages_and_input()
         seq = x
@@ -56,6 +57,7 @@ class TestPipeline:
             np.asarray(out.reshape(seq.shape)), np.asarray(seq), atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_gradients_match_sequential(self):
         stage_fn, per_stage, x = _stages_and_input(1)
         stacked = stack_stage_params(per_stage)
@@ -82,6 +84,7 @@ class TestPipeline:
             pp_g, ref_g,
         )
 
+    @pytest.mark.slow
     def test_eight_stage_pipeline(self):
         """Use the full 8-device mesh as 8 stages."""
         block = Block(num_heads=2)

@@ -82,6 +82,7 @@ class TestDownloadSeam:
 
 
 class TestRealDigits:
+    @pytest.mark.slow
     def test_materialized_fixture_is_real_format(self, tmp_path):
         root = materialize_real_digits(str(tmp_path), n_users=20, seed=1)
         assert root is not None and leaf_available(root)
@@ -93,6 +94,7 @@ class TestRealDigits:
             open(os.path.join(root, "test", "all_data_0.json"))
         )["users"]  # same user set in both splits (read_data assumption)
 
+    @pytest.mark.slow
     def test_single_sample_users_load(self, tmp_path):
         # regression: a user with 1 sample writes an empty test entry
         # ((0,)-shaped x) which used to crash np.concatenate in load()
@@ -105,11 +107,13 @@ class TestRealDigits:
         ds = load(args)
         assert ds.client_num == 100
 
+    @pytest.mark.slow
     def test_subset_marker_written(self, tmp_path):
         root = materialize_real_digits(str(tmp_path), n_users=10)
         blob = json.load(open(os.path.join(root, "_source.json")))
         assert blob["is_mnist"] is False and blob["real_data"] is True
 
+    @pytest.mark.slow
     def test_learning_trajectory_on_real_data(self, tmp_path, caplog):
         """FedAvg+LR on the real digits climbs well past chance within
         25 rounds, through the normal load() path, with NO synthetic

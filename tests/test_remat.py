@@ -24,6 +24,7 @@ def _loss_fn(model, params, tokens):
 
 
 @pytest.mark.parametrize("cls", [TransformerLM, MoETransformerLM])
+@pytest.mark.slow
 def test_remat_is_numerically_invisible(cls):
     kw = dict(vocab_size=64, num_layers=2, num_heads=4, embed_dim=32, max_len=16)
     if cls is MoETransformerLM:

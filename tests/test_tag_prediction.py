@@ -44,6 +44,7 @@ class TestTagPrediction:
         assert ds.packed_train.y.shape[-1] == 500
         assert args.input_dim == 100  # loader recorded the realized dim
 
+    @pytest.mark.slow
     def test_trains_and_reports_precision_recall(self, args_factory):
         args = fedml_tpu.init(_args(args_factory))
         ds = load(args)

@@ -87,6 +87,7 @@ class TestSpecs:
         ln = tp_params["LayerNorm_0"]["scale"]
         assert ln.addressable_shards[0].data.shape == ln.shape
 
+    @pytest.mark.slow
     def test_indivisible_dim_falls_back_to_replicated(self):
         devs = jax.devices()[:8]
         mesh = Mesh(np.array(devs), ("tp",))  # tp=8; 3*DIM=96 divides, DIM=32 divides
@@ -100,6 +101,7 @@ class TestSpecs:
 
 
 class TestNumericEquivalence:
+    @pytest.mark.slow
     def test_dp_x_tp_step_matches_replicated(self):
         model, params, tokens = _model_and_batch()
         opt = optax.sgd(0.1)

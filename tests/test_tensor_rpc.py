@@ -152,6 +152,7 @@ class TestPipes:
 
 
 class TestCrossSiloTrpc:
+    @pytest.mark.slow
     def test_trpc_matches_local(self, args_factory):
         """The reference benchmarks TRPC as its fastest backend; ours
         must first be *correct*: same global model as LOCAL."""
