@@ -40,61 +40,162 @@ from ..constants import FEDML_DATA_MNIST_URL
 
 _DOWNLOAD_TIMEOUT_S = 15
 
+# dataset -> archives, straight from the reference's download scripts
+# (data/<ds>/download*.sh): same hosts, same artifact names. Both
+# stackoverflow tasks share the h5 + the two vocab side files.
+_SO_ARCHIVES = (
+    "https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tar.bz2",
+    "https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.word_count.tar.bz2",
+    "https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tag_count.tar.bz2",
+)
+DATASET_ARCHIVES = {
+    "mnist": (FEDML_DATA_MNIST_URL,),
+    "fed_cifar100": (
+        "https://fedml.s3-us-west-1.amazonaws.com/fed_cifar100.tar.bz2",
+    ),
+    "fed_shakespeare": (
+        "https://fedml.s3-us-west-1.amazonaws.com/shakespeare.tar.bz2",
+    ),
+    "femnist": (
+        "https://fedml.s3-us-west-1.amazonaws.com/fed_emnist.tar.bz2",
+    ),
+    "stackoverflow_nwp": _SO_ARCHIVES,
+    "stackoverflow_lr": _SO_ARCHIVES,
+}
+
+
+def _fetch(url: str, dest: str) -> None:
+    """Stream ``url`` to ``dest`` atomically (no partial files)."""
+    tmp_name = None
+    try:
+        with urllib.request.urlopen(
+            url, timeout=_DOWNLOAD_TIMEOUT_S
+        ) as r, tempfile.NamedTemporaryFile(
+            dir=os.path.dirname(dest), delete=False
+        ) as tmp:
+            tmp_name = tmp.name
+            shutil.copyfileobj(r, tmp)
+        os.replace(tmp_name, dest)
+        tmp_name = None
+    finally:
+        if tmp_name is not None:  # failed mid-copy: no orphans
+            try:
+                os.unlink(tmp_name)
+            except OSError:
+                pass
+
+
+def _extract(archive: str, out_dir: str) -> None:
+    import tarfile
+
+    if archive.endswith(".zip"):
+        with zipfile.ZipFile(archive, "r") as zf:
+            zf.extractall(out_dir)
+    else:
+        with tarfile.open(archive, "r:*") as tf:
+            tf.extractall(out_dir, filter="data")
+
+
+def _fetch_and_extract(url: str, cache_dir: str, out_dir: str) -> None:
+    """Download (cached) + extract one archive, refetching once when a
+    previously-interrupted download left a corrupt file behind."""
+    import tarfile
+
+    archive = os.path.join(cache_dir, os.path.basename(url))
+    if not os.path.exists(archive):
+        _fetch(url, archive)
+    try:
+        _extract(archive, out_dir)
+    except (zipfile.BadZipFile, tarfile.TarError, EOFError):
+        logging.warning("corrupt %s; re-downloading", archive)
+        os.unlink(archive)
+        _fetch(url, archive)
+        _extract(archive, out_dir)
+
+
+def _normalize_layout(root: str) -> None:
+    """Archives differ in nesting (MNIST.zip carries ``MNIST/``, the
+    TFF tarballs a dataset-named dir): hoist any single-level nesting
+    so the loader's probes (<root>/train/*.json, <root>/*_{train,
+    test}.h5, side files) find the artifacts."""
+    if not os.path.isdir(root):
+        return
+    for sub in list(os.listdir(root)):
+        subdir = os.path.join(root, sub)
+        if not os.path.isdir(subdir) or sub in ("train", "test"):
+            continue
+        for inner in os.listdir(subdir):
+            target = os.path.join(root, inner)
+            if not os.path.exists(target):
+                os.rename(os.path.join(subdir, inner), target)
+        if not os.listdir(subdir):
+            os.rmdir(subdir)
+
+
+# both stackoverflow tasks read the same artifacts — extract them once
+# into one shared dir (the reference's layout) and symlink the
+# per-dataset names onto it
+_SHARED_EXTRACT_ROOT = {
+    "stackoverflow_nwp": "stackoverflow",
+    "stackoverflow_lr": "stackoverflow",
+}
+
+
+def dataset_downloadable(name: str) -> bool:
+    return name in DATASET_ARCHIVES
+
+
+def download_dataset(name: str, data_cache_dir: str, urls=None) -> bool:
+    """Fetch + extract ``name``'s reference archives into
+    ``<data_cache_dir>/<name>/``; False on any failure (offline grace —
+    the caller picks the fallback: loader.py degrades to its synthetic
+    stand-in).
+
+    All-or-nothing: archives extract into a staging dir that only moves
+    into place once EVERY archive landed, so a partial multi-archive
+    download (e.g. stackoverflow's h5 without its vocab side files) can
+    never leave a half-usable dataset dir that suppresses retries.
+    """
+    if urls is None:
+        urls = DATASET_ARCHIVES.get(name)
+    if not urls:
+        logging.warning("dataset %s: no download source known", name)
+        return False
+    shared = _SHARED_EXTRACT_ROOT.get(name, name)
+    root = os.path.join(data_cache_dir, shared)
+    staging = os.path.join(data_cache_dir, f".staging_{shared}")
+    os.makedirs(data_cache_dir, exist_ok=True)
+    if not os.path.isdir(root):
+        try:
+            shutil.rmtree(staging, ignore_errors=True)
+            os.makedirs(staging)
+            for url in urls:
+                _fetch_and_extract(url, data_cache_dir, staging)
+            _normalize_layout(staging)
+            os.rename(staging, root)
+        except Exception as e:  # noqa: BLE001 — offline grace is the point
+            shutil.rmtree(staging, ignore_errors=True)
+            logging.warning(
+                "%s download unavailable (%s: %s); proceeding without it",
+                name, type(e).__name__, e,
+            )
+            return False
+    if shared != name:
+        link = os.path.join(data_cache_dir, name)
+        if not os.path.exists(link):
+            os.symlink(shared, link)
+    return True
+
 
 def download_mnist(
     data_cache_dir: str, url: str = FEDML_DATA_MNIST_URL
 ) -> bool:
-    """Fetch + extract the reference MNIST LEAF archive; False on any
-    failure (offline grace — the caller picks the fallback)."""
-    os.makedirs(data_cache_dir, exist_ok=True)
-    zip_path = os.path.join(data_cache_dir, "MNIST.zip")
-
-    def fetch() -> None:
-        tmp_name = None
-        try:
-            with urllib.request.urlopen(
-                url, timeout=_DOWNLOAD_TIMEOUT_S
-            ) as r, tempfile.NamedTemporaryFile(
-                dir=data_cache_dir, delete=False
-            ) as tmp:
-                tmp_name = tmp.name
-                shutil.copyfileobj(r, tmp)
-            os.replace(tmp_name, zip_path)
-            tmp_name = None
-        finally:
-            if tmp_name is not None:  # failed mid-copy: no orphans
-                try:
-                    os.unlink(tmp_name)
-                except OSError:
-                    pass
-
-    try:
-        if not os.path.exists(zip_path):
-            fetch()
-        try:
-            with zipfile.ZipFile(zip_path, "r") as zf:
-                zf.extractall(data_cache_dir)
-        except zipfile.BadZipFile:
-            # a truncated archive (e.g. an interrupted earlier download)
-            # must not disable the path forever: refetch once
-            logging.warning("corrupt %s; re-downloading", zip_path)
-            os.unlink(zip_path)
-            fetch()
-            with zipfile.ZipFile(zip_path, "r") as zf:
-                zf.extractall(data_cache_dir)
-    except Exception as e:  # noqa: BLE001 — offline grace is the point
-        logging.warning(
-            "mnist download unavailable (%s: %s); proceeding without it",
-            type(e).__name__, e,
-        )
-        return False
-    # loader resolves <cache>/<lowercase name>; the reference archive
-    # extracts as MNIST/
-    upper = os.path.join(data_cache_dir, "MNIST")
-    lower = os.path.join(data_cache_dir, "mnist")
-    if os.path.isdir(upper) and not os.path.isdir(lower):
-        os.rename(upper, lower)
-    return os.path.isdir(os.path.join(lower, "train"))
+    """Reference-parity entry (data/MNIST/data_loader.py:17-29):
+    fetch + extract the MNIST LEAF archive; False on any failure."""
+    ok = download_dataset("mnist", data_cache_dir, urls=(url,))
+    return ok and os.path.isdir(
+        os.path.join(data_cache_dir, "mnist", "train")
+    )
 
 
 def materialize_real_digits(

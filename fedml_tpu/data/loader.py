@@ -149,17 +149,20 @@ def _try_load_federated(name: str, cache_dir: str, args=None):
     from . import ingest
     from .leaf import leaf_available, load_leaf
 
-    if (
-        name == "mnist"
-        and cache_dir
-        and not leaf_available(d)
-        and bool(getattr(args, "download", False))
-    ):
-        # reference parity: auto-fetch the MNIST LEAF archive
-        # (data/MNIST/data_loader.py:17-29) — with offline grace
-        from .download import download_mnist
+    if cache_dir and bool(getattr(args, "download", False)):
+        from .download import dataset_downloadable, download_dataset
 
-        download_mnist(cache_dir)
+        # a LEAF json dir only counts as a local copy for tasks that
+        # actually consume it — the nwp path deliberately ignores LEAF
+        # json (see below), so it must not suppress the h5 download
+        has_local = ingest.tff_h5_available(d, name) or (
+            task != "nwp" and leaf_available(d)
+        )
+        if dataset_downloadable(name) and not has_local:
+            # reference parity: auto-fetch the dataset's archives
+            # (data/<ds>/download*.sh; MNIST data_loader.py:17-29) —
+            # with offline grace
+            download_dataset(name, cache_dir)
 
     out = None
     if leaf_available(d):

@@ -54,16 +54,114 @@ class TestDownloadSeam:
         assert ok is True
         assert leaf_available(str(cache / "mnist"))
 
+    def test_tff_tarball_download_extract_and_load(self, tmp_path, args_factory):
+        """The generalized seam handles the reference's tar.bz2 TFF
+        archives (fed_cifar100 here), incl. hoisting a nested top-level
+        dir, via a file:// URL — fully offline."""
+        import tarfile
+
+        import h5py
+
+        from fedml_tpu.data.download import DATASET_ARCHIVES, download_dataset
+        from fedml_tpu.data.ingest import tff_h5_available
+
+        src = tmp_path / "src" / "nested"
+        os.makedirs(src)
+        rng = np.random.RandomState(0)
+        for split, n in (("train", 6), ("test", 2)):
+            with h5py.File(str(src / f"fed_cifar100_{split}.h5"), "w") as f:
+                g = f.create_group("examples")
+                for c in range(2):
+                    cg = g.create_group(f"client_{c}")
+                    cg.create_dataset(
+                        "image",
+                        data=rng.randint(0, 256, (n, 32, 32, 3), np.uint8),
+                    )
+                    cg.create_dataset(
+                        "label", data=rng.randint(0, 100, (n, 1), np.int64)
+                    )
+        tar_path = tmp_path / "fed_cifar100.tar.bz2"
+        with tarfile.open(tar_path, "w:bz2") as tf:
+            tf.add(str(src), arcname="nested")
+
+        cache = tmp_path / "cache"
+        os.makedirs(cache)
+        saved = DATASET_ARCHIVES["fed_cifar100"]
+        DATASET_ARCHIVES["fed_cifar100"] = (f"file://{tar_path}",)
+        try:
+            assert download_dataset("fed_cifar100", str(cache)) is True
+        finally:
+            DATASET_ARCHIVES["fed_cifar100"] = saved
+        assert tff_h5_available(str(cache / "fed_cifar100"), "fed_cifar100")
+
+        from fedml_tpu.data import load
+
+        args = make_args(
+            dataset="fed_cifar100", data_cache_dir=str(cache),
+            client_num_in_total=2, client_num_per_round=2,
+            model="cnn", batch_size=4,
+        )
+        ds = load(args)
+        assert ds.client_num == 2 and ds.class_num == 100
+
+    def test_partial_multi_archive_download_leaves_nothing(self, tmp_path):
+        """All-or-nothing staging: when the second archive of a
+        multi-archive dataset fails, NO dataset dir may appear (a
+        half-extracted dir would suppress retries and crash the
+        loader on the missing side files)."""
+        import tarfile
+
+        from fedml_tpu.data.download import download_dataset
+
+        src = tmp_path / "stackoverflow_train.h5"
+        src.write_bytes(b"not really h5 but extractable")
+        tar_path = tmp_path / "so.tar.bz2"
+        with tarfile.open(tar_path, "w:bz2") as tf:
+            tf.add(str(src), arcname="stackoverflow_train.h5")
+        cache = tmp_path / "cache"
+        ok = download_dataset(
+            "stackoverflow_lr", str(cache),
+            urls=(f"file://{tar_path}", "http://127.0.0.1:9/missing.tar.bz2"),
+        )
+        assert ok is False
+        assert not os.path.exists(cache / "stackoverflow")
+        assert not os.path.exists(cache / "stackoverflow_lr")
+        assert not any(p.name.startswith(".staging") for p in cache.iterdir())
+
+    def test_stackoverflow_tasks_share_one_extraction(self, tmp_path):
+        """Both SO tasks symlink onto one extracted dir — the multi-GB
+        archive is never unpacked twice."""
+        import tarfile
+
+        from fedml_tpu.data.download import download_dataset
+
+        src = tmp_path / "stackoverflow_train.h5"
+        src.write_bytes(b"payload")
+        tar_path = tmp_path / "so.tar.bz2"
+        with tarfile.open(tar_path, "w:bz2") as tf:
+            tf.add(str(src), arcname="stackoverflow_train.h5")
+        cache = tmp_path / "cache"
+        assert download_dataset(
+            "stackoverflow_nwp", str(cache), urls=(f"file://{tar_path}",)
+        )
+        assert download_dataset(
+            "stackoverflow_lr", str(cache), urls=(f"file://{tar_path}",)
+        )
+        assert (cache / "stackoverflow" / "stackoverflow_train.h5").is_file()
+        assert os.path.islink(cache / "stackoverflow_nwp")
+        assert os.path.islink(cache / "stackoverflow_lr")
+        assert (cache / "stackoverflow_lr" / "stackoverflow_train.h5").is_file()
+
     def test_loader_attempts_download_only_when_asked(self, tmp_path, monkeypatch):
         calls = []
 
-        def fake_download(cache_dir, url=None):
+        def fake_download(name, cache_dir):
             calls.append(cache_dir)
             return False
 
         import fedml_tpu.data.download as dl
 
-        monkeypatch.setattr(dl, "download_mnist", fake_download)
+        monkeypatch.setattr(dl, "download_dataset", fake_download)
         args = make_args(
             dataset="mnist",
             data_cache_dir=str(tmp_path),
