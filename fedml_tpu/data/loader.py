@@ -116,27 +116,37 @@ class FederatedDataset:
         ]
 
 
-def _try_load_real(name: str, cache_dir: str, args=None):
+def _try_load_real(name: str, cache_dir: str, args=None, probe: bool = False):
     """Global real data: CIFAR python batches, ImageNet-style image
-    folders, else the generic {train,test}.npz drop-in."""
+    folders, else the generic {train,test}.npz drop-in.
+
+    ``probe=True`` answers "is real data on disk?" (returns bool) using
+    the SAME branches as loading — one resolution order, so a source
+    added here is automatically seen by the device-synthesis gate
+    (loader._device_synth_classification) and can never be shadowed by
+    a stand-in."""
     d = os.path.join(cache_dir or "", name)
     if name in ("cifar10", "cifar100"):
         from .ingest import cifar_batches_available, load_cifar_batches
 
         if cifar_batches_available(d, name):
-            return load_cifar_batches(d, name)
+            return True if probe else load_cifar_batches(d, name)
     from .ingest import image_folder_available, load_image_folder
 
     if image_folder_available(d):
+        if probe:
+            return True
         hw = int(getattr(args, "image_size", 64) or 64) if args else 64
         # 5-tuple: the folder structure is authoritative for class
         # count (truncated ImageNet copies carry fewer classes)
         return load_image_folder(d, (hw, hw))
     tr, te = os.path.join(d, "train.npz"), os.path.join(d, "test.npz")
     if os.path.exists(tr) and os.path.exists(te):
+        if probe:
+            return True
         a, b = np.load(tr), np.load(te)
         return (a["x"], a["y"], b["x"], b["y"])
-    return None
+    return False if probe else None
 
 
 def _try_load_federated(name: str, cache_dir: str, args=None):
@@ -192,6 +202,130 @@ def _try_load_federated(name: str, cache_dir: str, args=None):
 
 
 
+def _standin_shape_and_sizes(args, name: str):
+    """Shared stand-in geometry for the host (:func:`_raw_data`) and
+    device (:func:`_device_synth_classification`) synthesis paths: the
+    dataset's feature shape (resized-image datasets follow
+    ``args.image_size`` exactly like the real ingestion) and the
+    synthetic train/test sizes with their default caps. One
+    implementation, so the two paths can never drift apart for the same
+    args."""
+    shape, class_num, train_n, test_n, task = _DATASET_META[name]
+    if name in ("imagenet", "gld23k", "gld160k"):
+        hw = int(getattr(args, "image_size", 64) or 64)
+        shape = (hw, hw, 3)
+    train_n = int(getattr(args, "synthetic_train_size", min(train_n, 20000)))
+    test_n = int(getattr(args, "synthetic_test_size", min(test_n, 4000)))
+    return shape, class_num, train_n, test_n, task
+
+
+def _device_synth_classification(
+    args, name: str, client_num: int, batch_size: int, seed: int
+):
+    """Zero-transfer stand-in path: when a classification dataset has no
+    local copy (this environment has no egress), partition host-side
+    labels and synthesize the feature tensor directly on the device —
+    the host->device link carries only labels + masks (KBs, vs >1 GB of
+    images for a CIFAR-shaped 100-client federation through the ~5 MB/s
+    tunneled TPU link). Returns a full :class:`FederatedDataset`, or
+    None when the path does not apply (real data on disk, non-image
+    task, non-stand-in dataset). Distribution family and the shared
+    class-means convention match ``synthetic_classification``."""
+    if name not in _DATASET_META:
+        return None
+    shape, class_num, train_n, test_n, task = _standin_shape_and_sizes(args, name)
+    if task != "classification":
+        return None
+    if _try_load_real(name, getattr(args, "data_cache_dir", None), args, probe=True):
+        return None
+    logging.warning(
+        "dataset %s: no local copy under data_cache_dir; using synthetic "
+        "stand-in with identical shapes/classes (features generated "
+        "on-device)", name,
+    )
+    import jax.numpy as jnp
+
+    from .packing import pack_labels_np
+    from .synthetic import synthetic_classification_device
+
+    rng = np.random.RandomState(seed)
+    y_tr = rng.randint(0, class_num, train_n).astype(np.int64)
+    y_te = np.random.RandomState(seed + 1).randint(0, class_num, test_n).astype(
+        np.int64
+    )
+
+    method = getattr(args, "partition_method", constants.PARTITION_HETERO)
+    if method == constants.PARTITION_HOMO:
+        idx_map = homo_partition(train_n, client_num, seed)
+    else:
+        idx_map = non_iid_partition_with_dirichlet_distribution(
+            y_tr, client_num, class_num,
+            float(getattr(args, "partition_alpha", 0.5)), seed=seed,
+        )
+        record_data_stats(y_tr, idx_map)
+    ys_tr = [y_tr[idx_map[i]] for i in range(client_num)]
+    te_map = homo_partition(test_n, client_num, seed + 1)
+    ys_te = [y_te[te_map[i]] for i in range(client_num)]
+
+    waste_cap = float(getattr(args, "packing_waste_cap", 4.0) or 4.0)
+    x_dtype = (
+        jnp.bfloat16
+        if str(getattr(args, "dtype", "float32") or "float32") == "bfloat16"
+        else jnp.float32
+    )
+    sigma = float(getattr(args, "synthetic_sigma", 1.0) or 1.0)
+
+    def build(ys, gen_seed):
+        nb = bucket_num_batches([len(y) for y in ys], batch_size, waste_cap=waste_cap)
+        y_p, mask, num_samples = pack_labels_np(ys, batch_size, num_batches=nb)
+        x = synthetic_classification_device(
+            y_p, shape, class_num, seed=gen_seed, sigma=sigma, dtype=x_dtype
+        )
+        packed = Batches(
+            x=x, y=jnp.asarray(y_p, jnp.int32), mask=jnp.asarray(mask)
+        )
+        return packed, num_samples
+
+    packed_train, num_samples = build(ys_tr, seed)
+    packed_test, test_num_samples = build(ys_te, seed + 1)
+
+    def flat(p: Batches) -> Batches:
+        # the global view is the packed federation flattened on-device:
+        # exactly the packed samples (long-tail clients past the
+        # waste-cap are truncated by the packer, which warns), mask
+        # keeps ragged semantics exact (pads carry mask 0). No second
+        # transfer, no host concat.
+        C, nb = p.mask.shape[0], p.mask.shape[1]
+        return Batches(
+            x=p.x.reshape((C * nb,) + p.x.shape[2:]),
+            y=p.y.reshape((C * nb,) + p.y.shape[2:]),
+            mask=p.mask.reshape(C * nb, -1),
+        )
+
+    # counts reflect the packed federation (post-truncation), so every
+    # view of this dataset object agrees with its metadata
+    sizes = [int(n) for n in num_samples]
+    return FederatedDataset(
+        train_data_num=int(sum(sizes)),
+        test_data_num=int(test_num_samples.sum()),
+        train_data_global=flat(packed_train),
+        test_data_global=flat(packed_test),
+        train_data_local_num_dict={i: int(s) for i, s in enumerate(sizes)},
+        train_data_local_dict={
+            i: _client_view(packed_train, i) for i in range(client_num)
+        },
+        test_data_local_dict={
+            i: _client_view(packed_test, i) for i in range(client_num)
+        },
+        class_num=class_num,
+        packed_train=packed_train,
+        packed_num_samples=np.asarray(num_samples),
+        packed_test=packed_test,
+        client_num=client_num,
+        task=task,
+    )
+
+
 def _widen_class_num(name: str, class_num: int, observed: int) -> int:
     """Custom/truncated on-disk copies may carry ids beyond the
     canonical class count; widen the head rather than training silently
@@ -213,13 +347,7 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
         raise RuntimeError("synthetic handled separately")
     if name not in _DATASET_META:
         raise ValueError(f"unknown dataset {name!r}")
-    shape, class_num, train_n, test_n, task = _DATASET_META[name]
-    if name in ("imagenet", "gld23k", "gld160k"):
-        # resized-image datasets: stand-in shape follows args.image_size
-        # exactly like the real ingestion, so model example_shape and
-        # data always agree
-        hw = int(getattr(args, "image_size", 64) or 64)
-        shape = (hw, hw, 3)
+    shape, class_num, train_n, test_n, task = _standin_shape_and_sizes(args, name)
     real = _try_load_real(name, getattr(args, "data_cache_dir", None), args)
     if real is not None:
         if len(real) == 5:  # loader knows its own class count
@@ -232,8 +360,6 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
         "stand-in with identical shapes/classes",
         name,
     )
-    train_n = int(getattr(args, "synthetic_train_size", min(train_n, 20000)))
-    test_n = int(getattr(args, "synthetic_test_size", min(test_n, 4000)))
     if task == "nwp":
         seq_len, vocab = shape[0], class_num
         x_tr, y_tr = synthetic_sequences(train_n, seq_len, vocab, seed)
@@ -318,6 +444,9 @@ def load(args) -> FederatedDataset:
             )
             class_num = _widen_class_num(name, class_num, observed)
     else:
+        dev_ds = _device_synth_classification(args, name, client_num, batch_size, seed)
+        if dev_ds is not None:
+            return dev_ds
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
         if task == "classification":
             observed = int(max(y_tr.max(initial=-1), y_te.max(initial=-1))) + 1

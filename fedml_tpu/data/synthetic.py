@@ -53,6 +53,15 @@ def synthetic_fedprox(
     return xs, ys
 
 
+def _class_means(num_classes: int, dim: int, means_seed: int) -> np.ndarray:
+    """The one class-means construction both the host and device
+    stand-in generators use — train/test and host/device synthesis
+    share a distribution only because this expression is shared."""
+    return np.random.RandomState(means_seed).normal(
+        0, 1, (num_classes, dim)
+    ).astype(np.float32)
+
+
 def synthetic_classification(
     n_samples: int,
     num_classes: int,
@@ -69,12 +78,53 @@ def synthetic_classification(
     seed so train/test splits share one distribution."""
     rng = np.random.RandomState(seed)
     dim = int(np.prod(feature_shape))
-    means = np.random.RandomState(means_seed).normal(
-        0, 1, (num_classes, dim)
-    ).astype(np.float32)
+    means = _class_means(num_classes, dim, means_seed)
     y = rng.randint(0, num_classes, n_samples).astype(np.int64)
     x = means[y] + sigma * rng.normal(0, 1, (n_samples, dim)).astype(np.float32)
     return x.reshape((n_samples,) + feature_shape), y
+
+
+def synthetic_classification_device(
+    y_packed: np.ndarray,
+    feature_shape: Tuple[int, ...],
+    num_classes: int,
+    seed: int = 0,
+    sigma: float = 1.0,
+    means_seed: int = 1234,
+    dtype=None,
+):
+    """Device-side twin of :func:`synthetic_classification`: given
+    host-packed labels ``y_packed`` (any leading shape), synthesize the
+    feature tensor ``means[y] + sigma * noise`` directly on the default
+    device with ``jax.random``.
+
+    Rationale: the stand-in datasets exist only in this zero-egress
+    environment, and materializing them host-side forces the whole
+    image tensor through the host->device link (the tunneled TPU here
+    moves ~5 MB/s — a CIFAR-shaped 100-client federation is >1 GB and
+    can never finish transferring inside a bench window). Shipping the
+    labels (KBs) and generating features in HBM makes cohort size a
+    compute knob instead of a bandwidth one. Same distribution family
+    and the same ``means_seed`` convention as the host generator (class
+    means shared across train/test); the noise stream is jax's threefry
+    rather than numpy's MT, which is deterministic across processes and
+    backends for a given seed."""
+    import jax
+    import jax.numpy as jnp
+
+    dim = int(np.prod(feature_shape))
+    means = _class_means(num_classes, dim, means_seed)
+    out_dtype = dtype or jnp.float32
+
+    @jax.jit
+    def gen(y, means):
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed), y.shape + (dim,), jnp.float32
+        )
+        x = means[y] + sigma * noise
+        return x.reshape(y.shape + tuple(feature_shape)).astype(out_dtype)
+
+    return gen(jnp.asarray(y_packed, jnp.int32), jnp.asarray(means))
 
 
 def synthetic_segmentation(

@@ -1,0 +1,132 @@
+"""On-device synthetic stand-ins (loader._device_synth_classification)
+and mixed-precision dtype propagation (models.spec.ensure_float).
+
+Why these exist: the tunneled TPU link moves ~5 MB/s, so stand-in
+federations must be generated in device memory (only labels/masks cross
+the link), and a blanket ``astype(float32)`` at a model's entry silently
+promotes every conv back to f32 under bf16 compute — both were found
+benching on the real chip.
+"""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.data import load
+from tests.conftest import make_args
+
+pytestmark = pytest.mark.smoke
+
+
+def _args(**over):
+    base = dict(
+        dataset="femnist",
+        synthetic_train_size=400,
+        synthetic_test_size=100,
+        model="cnn",
+        partition_method="hetero",
+        partition_alpha=0.5,
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=1,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.05,
+        frequency_of_the_test=1,
+    )
+    base.update(over)
+    return make_args(**base)
+
+
+class TestDeviceSynth:
+    def test_stand_in_goes_through_device_path(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            ds = load(_args())
+        assert "features generated on-device" in caplog.text
+        # contract fields all present and consistent
+        C, nb, bs = ds.packed_train.mask.shape
+        assert C == 4 and bs == 16
+        assert int(ds.packed_num_samples.sum()) == ds.train_data_num == 400
+        assert ds.train_data_global.x.shape[0] == C * nb
+
+    def test_deterministic_across_loads(self):
+        a, b = load(_args()), load(_args())
+        np.testing.assert_array_equal(np.asarray(a.packed_train.y), np.asarray(b.packed_train.y))
+        np.testing.assert_array_equal(np.asarray(a.packed_train.x), np.asarray(b.packed_train.x))
+
+    def test_global_view_is_flattened_packed(self):
+        ds = load(_args())
+        C, nb, bs = ds.packed_train.mask.shape
+        np.testing.assert_array_equal(
+            np.asarray(ds.train_data_global.x),
+            np.asarray(ds.packed_train.x).reshape((C * nb, bs) + ds.packed_train.x.shape[3:]),
+        )
+        # mask excludes pads: real-sample count survives the flatten
+        assert float(np.asarray(ds.train_data_global.mask).sum()) == 400.0
+
+    def test_bf16_dtype_packs_bf16(self):
+        import jax.numpy as jnp
+
+        ds = load(_args(dtype="bfloat16"))
+        assert ds.packed_train.x.dtype == jnp.bfloat16
+        assert ds.packed_train.y.dtype == jnp.int32
+
+    def test_real_leaf_copy_still_wins(self, tmp_path, caplog):
+        # with a LEAF dir on disk the device path must NOT trigger
+        import logging
+
+        args = _args(dataset="mnist", client_num_in_total=2, client_num_per_round=2)
+        args.data_cache_dir = "tests/data"
+        with caplog.at_level(logging.WARNING):
+            ds = load(args)
+        assert "stand-in" not in caplog.text
+        assert ds.train_data_num > 0
+
+    def test_homo_partition_supported(self):
+        ds = load(_args(partition_method="homo"))
+        sizes = list(ds.train_data_local_num_dict.values())
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_learnable_cnn_loss_drops(self):
+        from fedml_tpu.simulation import FedAvgAPI
+
+        args = _args(comm_round=3, learning_rate=0.1)
+        args = fedml_tpu.init(args)
+        ds = load(args)
+        model = models.create(args, ds.class_num)
+        api = FedAvgAPI(args, None, ds, model)
+        stats = api.train()
+        assert np.isfinite(stats["train_loss"])
+        assert stats["train_loss"] < np.log(62) + 0.2  # moved off init
+
+
+class TestEnsureFloat:
+    def test_resnet_preserves_bf16(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.models.resnet import resnet18_gn
+
+        m = resnet18_gn(10)
+        p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        pb = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            p,
+        )
+        out = m.apply(pb, jnp.zeros((2, 32, 32, 3), jnp.bfloat16))
+        assert out.dtype == jnp.bfloat16
+
+    def test_int_input_promoted_to_f32(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.models.spec import ensure_float
+
+        assert ensure_float(jnp.zeros((2,), jnp.uint8)).dtype == jnp.float32
+        assert ensure_float(jnp.zeros((2,), jnp.bfloat16)).dtype == jnp.bfloat16
+        assert ensure_float(jnp.zeros((2,), jnp.float32)).dtype == jnp.float32
