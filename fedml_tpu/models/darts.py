@@ -19,6 +19,8 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax
 import jax.numpy as jnp
 
@@ -111,7 +113,7 @@ class DARTSNetwork(nn.Module):
             lambda key: 1e-3
             * jax.random.normal(key, (num_edges(self.steps), len(PRIMITIVES))),
         )
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
         x = _gn(self.width)(x)
         for i in range(self.num_cells):

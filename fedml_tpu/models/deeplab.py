@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax
 import jax.numpy as jnp
 
@@ -66,7 +68,7 @@ class DeepLabLite(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         h, w = x.shape[1], x.shape[2]
         x = _ConvGN(self.width, 3, strides=2)(x)  # /2
         low = x

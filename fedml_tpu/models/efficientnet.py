@@ -14,6 +14,8 @@ import math
 from typing import Tuple
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 from .mobilenet import SqueezeExcite, _gn
@@ -86,7 +88,7 @@ class EfficientNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         stem = _round_channels(32 * self.width_mult)
         x = nn.Conv(stem, (3, 3), use_bias=False)(x)
         x = _gn(stem)(x)

@@ -10,6 +10,8 @@ pytrees — both nets are FedAvg'd across clients in FedGAN).
 from __future__ import annotations
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 
@@ -39,7 +41,7 @@ class Discriminator(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         x = nn.Conv(32, (4, 4), strides=(2, 2))(x)  # 14x14
         x = nn.leaky_relu(x, 0.2)
         x = nn.Conv(64, (4, 4), strides=(2, 2))(x)  # 7x7

@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 from .resnet import BasicBlock, _gn
@@ -28,7 +30,7 @@ class GKTClientNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         x = nn.Conv(self.channels, (3, 3), use_bias=False)(x)
         x = _gn(self.channels)(x)
         x = nn.relu(x)

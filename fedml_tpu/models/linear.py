@@ -3,6 +3,8 @@
 from __future__ import annotations
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 
@@ -15,7 +17,7 @@ class LogisticRegression(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = ensure_float(x.reshape((x.shape[0], -1)))
         return nn.Dense(self.output_dim)(x)
 
 
@@ -27,6 +29,6 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = ensure_float(x.reshape((x.shape[0], -1)))
         x = nn.relu(nn.Dense(self.hidden_dim)(x))
         return nn.Dense(self.output_dim)(x)

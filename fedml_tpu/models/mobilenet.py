@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 
@@ -55,7 +57,7 @@ class MobileNetV1(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
 
         def c(ch: int) -> int:
             return max(8, int(ch * self.width))
@@ -140,7 +142,7 @@ class MobileNetV3Small(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         x = nn.Conv(16, (3, 3), use_bias=False)(x)
         x = _gn(16)(x)
         x = _hardswish(x)

@@ -18,6 +18,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 
@@ -56,7 +58,7 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         ch0 = self.stage_channels[0]
         k = self.stem_kernel
         x = nn.Conv(ch0, (k, k), strides=(2, 2) if self.stem_pool else (1, 1), use_bias=False)(x)

@@ -24,6 +24,19 @@ from ..core.losses import LOSSES
 Params = Any
 
 
+def ensure_float(x: jax.Array) -> jax.Array:
+    """Promote integer/bool inputs to f32; leave float inputs ALONE.
+
+    Model entry points must not force f32: under mixed precision the
+    trainer hands the model bf16 inputs and bf16-cast params, and a
+    blanket ``astype(float32)`` silently promotes every conv/matmul
+    back to f32 (one bf16 operand + one f32 operand -> f32 compute),
+    forfeiting the MXU's 2x bf16 throughput."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.float32)
+    return x
+
+
 @dataclasses.dataclass(frozen=True)
 class FedModel:
     name: str

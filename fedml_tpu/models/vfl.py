@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 
@@ -25,7 +27,7 @@ class PartyLocalModel(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         for h in self.hidden_dims:
             x = nn.relu(nn.Dense(h)(x))
         return nn.Dense(self.output_dim)(x)

@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence, Union
 
 import flax.linen as nn
+
+from .spec import ensure_float
 import jax.numpy as jnp
 
 _PLANS = {
@@ -35,7 +37,7 @@ class VGG(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(jnp.float32)
+        x = ensure_float(x)
         for item in self.plan:
             if item == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
