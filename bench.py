@@ -34,7 +34,16 @@ same jitted per-client step so the comparison isolates architecture).
   (msgpack serialize + deserialize + device_put, what every reference
   exchange does) round-trip time for the model tree;
 - ``bf16``: the same cohort under dtype=bfloat16 (core/local_trainer.py
-  mixed precision) and its speedup over the f32 headline.
+  mixed precision) and its speedup over the f32 headline;
+- ``longctx``: the pallas flash-attention kernel vs naive XLA attention
+  at T=4096 bf16, fwd+bwd tokens/s (ops/flash_attention.py — the
+  long-context per-chip hot op under ring/Ulysses sequence parallelism).
+
+Stand-in data is synthesized ON DEVICE (data/loader.py
+_device_synth_classification): the tunneled TPU link here moves ~5 MB/s,
+so host-materialized cohorts (>1 GB for the dense phase) could never
+finish transferring inside a bench window — only labels/masks cross the
+link.
 
 Robustness contract (VERDICT round 1, hardened rounds 3-4): TPU init
 is probed in a subprocess with a timeout; on failure we retry then
@@ -179,7 +188,9 @@ def _time_rounds(api, dataset, args, n_rounds: int):
     lowered = api._round_fn.lower(
         params, state, packed, nsamples, idx, jax.random.fold_in(rng, 0)
     )
+    _progress("round fn lowered")
     compiled = lowered.compile()
+    _progress("round fn compiled")
     flops = None
     try:
         ca = compiled.cost_analysis()
@@ -435,6 +446,69 @@ def run_dense(on_cpu: bool) -> dict:
     return out
 
 
+def run_longctx(on_cpu: bool) -> dict:
+    """Long-context kernel phase: the pallas flash-attention kernel
+    (ops/flash_attention.py — blockwise online-softmax, custom_vjp
+    blockwise backward) vs naive XLA attention (materializes the [T, T]
+    score matrix), fwd+bwd, bf16 on TPU. Reports tokens/s each way and
+    the score-matrix HBM traffic the kernel never pays. On CPU fallback
+    the kernel runs in interpreter mode, so shapes are tiny and numbers
+    demoted — the phase exists to be measured on the TPU."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.flash_attention import flash_attention
+
+    if on_cpu:
+        B, H, T, D, iters = 1, 2, 256, 32, 2
+    else:
+        B, H, T, D, iters = 4, 8, 4096, 64, 10
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, T, H, D), dtype)
+    v = jax.random.normal(ks[2], (B, T, H, D), dtype)
+
+    def naive(q, k, v):
+        # [B, T, H, D] -> [B, H, T, T] scores, causal-masked softmax
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s = s / (D ** 0.5)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def step_fn(attn):
+        def loss(q, k, v):
+            return attn(q, k, v).astype(jnp.float32).sum()
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    flash = functools.partial(flash_attention, causal=True)
+    out = {"shape": f"B{B} H{H} T{T} D{D}", "dtype": str(dtype.__name__)}
+    for name, attn in (("flash", flash), ("naive", naive)):
+        f = step_fn(attn)
+        r = f(q, k, v)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(q, k, v)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        out[f"{name}_ms"] = round(dt * 1e3, 2)
+        out[f"{name}_tokens_per_sec"] = round(B * T / dt, 1)
+        _progress(f"longctx {name}: {dt*1e3:.1f} ms/step")
+    out["flash_speedup_vs_naive"] = round(
+        out["naive_ms"] / max(out["flash_ms"], 1e-9), 2
+    )
+    # the [B, H, T, T] f32 score matrix naive writes+reads to HBM and
+    # flash never materializes (forward; backward recomputes blockwise)
+    out["score_matrix_mb_avoided"] = round(B * H * T * T * 4 / 1e6, 1)
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -449,6 +523,16 @@ def run_sweep_cohort(c: int) -> dict:
 
 def _child_env() -> dict:
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # Persistent XLA compilation cache: the dominant cost of a cold
+    # bench is first-compiles (67s headline, minutes for the ResNet
+    # cohort). The cache is keyed on HLO+backend, so a second bench run
+    # on the same chip replays them in seconds — phases that miss their
+    # window cold land comfortably warm.
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_compile_cache"
+    )
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     return env
 
 
@@ -502,9 +586,14 @@ _HEADLINE_TIMEOUT_S = 270.0
 # size the window for compile + 3 timed rounds, not just the rounds
 _DENSE_TIMEOUT_S = 170.0
 _BF16_TIMEOUT_S = 90.0
-_SWEEP_TIMEOUT_S = 60.0
+_LONGCTX_TIMEOUT_S = 110.0
+_SWEEP_TIMEOUT_S = 90.0
 _SWEEP_COHORTS = [8, 32, 256]
 _LATE_PROBE_TIMEOUT_S = 60.0
+# after any TPU phase times out, the tunnel may be wedged (observed:
+# every later backend init hangs, even jax.devices()). A quick probe
+# decides in ~15s whether to keep spending phase windows on it.
+_WEDGE_PROBE_TIMEOUT_S = 20.0
 
 
 def _elapsed() -> float:
@@ -603,20 +692,47 @@ def _main_guarded() -> None:
         )
         return
 
+    # Tunnel-wedge tracking: once any TPU phase times out, later phases
+    # are likely to hang at backend init (observed failure mode) — a
+    # 20s probe decides whether to keep spending their windows.
+    wedge = {"suspect": False, "dead": False}
+
+    def _tunnel_usable() -> bool:
+        if not tpu_ok:
+            return False
+        if wedge["dead"]:
+            return False
+        if wedge["suspect"]:
+            ok, pnote = _probe_tpu(_WEDGE_PROBE_TIMEOUT_S, attempts=1)
+            _progress(f"wedge probe: ok={ok} ({pnote})")
+            wedge["suspect"] = False
+            wedge["dead"] = not ok
+            return ok
+        return True
+
+    def _note_phase_outcome(note: str) -> None:
+        if "timeout" in note:
+            wedge["suspect"] = True
+
     # compute-dense phase (ResNet-18/CIFAR-10, bf16): the MFU number
     # that matters. On TPU it runs the north-star cohort; on fallback a
     # demoted mini-cohort so the phase is still exercised.
-    remaining = _BUDGET_S - _elapsed()
-    if remaining > 60:
-        dense_args = ["--phase", "dense"] + ([] if tpu_ok else ["--cpu"])
+    if _BUDGET_S - _elapsed() > 60:
+        on_tpu = _tunnel_usable()
+        # recompute AFTER the gate: _tunnel_usable may have spent up to
+        # _WEDGE_PROBE_TIMEOUT_S probing, and the child window must fit
+        # what is actually left (same in every gate below)
+        remaining = _BUDGET_S - _elapsed()
+        dense_args = ["--phase", "dense"] + ([] if on_tpu else ["--cpu"])
         dense, dnote = _run_phase_subprocess(
-            dense_args, min(_DENSE_TIMEOUT_S, remaining - 10)
+            dense_args, min(_DENSE_TIMEOUT_S, max(remaining - 10, 30))
         )
         if dense is not None:
-            if not tpu_ok:
+            if not on_tpu:
                 dense["cpu_fallback"] = True
             result["detail"]["dense"] = dense
         else:
+            _note_phase_outcome(dnote)
             result["detail"]["dense_skipped"] = dnote
             _progress(f"dense phase skipped ({dnote})")
     else:
@@ -632,11 +748,17 @@ def _main_guarded() -> None:
                 skipped.append({"clients": c, "reason": "budget exhausted"})
                 _progress(f"sweep cohort {c}: skipped (budget)")
                 continue
+            if not _tunnel_usable():
+                skipped.append({"clients": c, "reason": "tunnel wedged"})
+                _progress(f"sweep cohort {c}: skipped (tunnel wedged)")
+                continue
+            remaining = _BUDGET_S - _elapsed()
             entry, snote = _run_phase_subprocess(
                 ["--phase", "sweep", "--cohort", str(c)],
-                min(_SWEEP_TIMEOUT_S, remaining - 5),
+                min(_SWEEP_TIMEOUT_S, max(remaining - 5, 30)),
             )
             if entry is None:
+                _note_phase_outcome(snote)
                 skipped.append({"clients": c, "reason": snote})
                 _progress(f"sweep cohort {c}: skipped ({snote})")
             else:
@@ -660,10 +782,14 @@ def _main_guarded() -> None:
             result["detail"]["scaling_skipped"] = skipped
 
         # mixed-precision point (own child): bf16 vs the f32 headline
-        remaining = _BUDGET_S - _elapsed()
-        if remaining > 100:
+        if _BUDGET_S - _elapsed() <= 100:
+            result["detail"]["bf16_skipped"] = "budget exhausted"
+        elif not _tunnel_usable():
+            result["detail"]["bf16_skipped"] = "tunnel wedged"
+        else:
+            remaining = _BUDGET_S - _elapsed()
             bf16, bnote = _run_phase_subprocess(
-                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
+                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, max(remaining - 10, 30))
             )
             if bf16 is not None:
                 bf16["speedup_vs_f32"] = round(
@@ -671,10 +797,27 @@ def _main_guarded() -> None:
                 )
                 result["detail"]["bf16"] = bf16
             else:
+                _note_phase_outcome(bnote)
                 result["detail"]["bf16_skipped"] = bnote
                 _progress(f"bf16 phase skipped ({bnote})")
+
+        # long-context kernel point (own child): pallas flash attention
+        # vs naive XLA attention at T=4096 — the long-context perf story
+        if _BUDGET_S - _elapsed() <= 70:
+            result["detail"]["longctx_skipped"] = "budget exhausted"
+        elif not _tunnel_usable():
+            result["detail"]["longctx_skipped"] = "tunnel wedged"
         else:
-            result["detail"]["bf16_skipped"] = "budget exhausted"
+            remaining = _BUDGET_S - _elapsed()
+            lc, lcnote = _run_phase_subprocess(
+                ["--phase", "longctx"],
+                min(_LONGCTX_TIMEOUT_S, max(remaining - 10, 30)),
+            )
+            if lc is not None:
+                result["detail"]["longctx"] = lc
+            else:
+                result["detail"]["longctx_skipped"] = lcnote
+                _progress(f"longctx phase skipped ({lcnote})")
 
     _emit(result)
 
@@ -685,7 +828,8 @@ def _phase_main(argv) -> None:
 
     p = argparse.ArgumentParser()
     p.add_argument(
-        "--phase", required=True, choices=["headline", "bf16", "dense", "sweep"]
+        "--phase", required=True,
+        choices=["headline", "bf16", "dense", "sweep", "longctx"],
     )
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
@@ -699,6 +843,8 @@ def _phase_main(argv) -> None:
         out = run_bf16(on_cpu=a.cpu)
     elif a.phase == "dense":
         out = run_dense(on_cpu=a.cpu)
+    elif a.phase == "longctx":
+        out = run_longctx(on_cpu=a.cpu)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:
