@@ -721,11 +721,16 @@ def _main_guarded() -> None:
         on_tpu = _tunnel_usable()
         # recompute AFTER the gate: _tunnel_usable may have spent up to
         # _WEDGE_PROBE_TIMEOUT_S probing, and the child window must fit
-        # what is actually left (same in every gate below)
+        # what is actually left — never floor past the budget (same in
+        # every gate below)
         remaining = _BUDGET_S - _elapsed()
         dense_args = ["--phase", "dense"] + ([] if on_tpu else ["--cpu"])
-        dense, dnote = _run_phase_subprocess(
-            dense_args, min(_DENSE_TIMEOUT_S, max(remaining - 10, 30))
+        dense, dnote = (
+            (None, "budget exhausted after probe")
+            if remaining < 40
+            else _run_phase_subprocess(
+                dense_args, min(_DENSE_TIMEOUT_S, remaining - 10)
+            )
         )
         if dense is not None:
             if not on_tpu:
@@ -753,9 +758,13 @@ def _main_guarded() -> None:
                 _progress(f"sweep cohort {c}: skipped (tunnel wedged)")
                 continue
             remaining = _BUDGET_S - _elapsed()
+            if remaining < 35:
+                skipped.append({"clients": c, "reason": "budget exhausted"})
+                _progress(f"sweep cohort {c}: skipped (budget after probe)")
+                continue
             entry, snote = _run_phase_subprocess(
                 ["--phase", "sweep", "--cohort", str(c)],
-                min(_SWEEP_TIMEOUT_S, max(remaining - 5, 30)),
+                min(_SWEEP_TIMEOUT_S, remaining - 5),
             )
             if entry is None:
                 _note_phase_outcome(snote)
@@ -788,8 +797,12 @@ def _main_guarded() -> None:
             result["detail"]["bf16_skipped"] = "tunnel wedged"
         else:
             remaining = _BUDGET_S - _elapsed()
-            bf16, bnote = _run_phase_subprocess(
-                ["--phase", "bf16"], min(_BF16_TIMEOUT_S, max(remaining - 10, 30))
+            bf16, bnote = (
+                (None, "budget exhausted after probe")
+                if remaining < 40
+                else _run_phase_subprocess(
+                    ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
+                )
             )
             if bf16 is not None:
                 bf16["speedup_vs_f32"] = round(
@@ -809,13 +822,18 @@ def _main_guarded() -> None:
             result["detail"]["longctx_skipped"] = "tunnel wedged"
         else:
             remaining = _BUDGET_S - _elapsed()
-            lc, lcnote = _run_phase_subprocess(
-                ["--phase", "longctx"],
-                min(_LONGCTX_TIMEOUT_S, max(remaining - 10, 30)),
+            lc, lcnote = (
+                (None, "budget exhausted after probe")
+                if remaining < 40
+                else _run_phase_subprocess(
+                    ["--phase", "longctx"],
+                    min(_LONGCTX_TIMEOUT_S, remaining - 10),
+                )
             )
             if lc is not None:
                 result["detail"]["longctx"] = lc
             else:
+                _note_phase_outcome(lcnote)
                 result["detail"]["longctx_skipped"] = lcnote
                 _progress(f"longctx phase skipped ({lcnote})")
 
