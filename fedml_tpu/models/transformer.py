@@ -30,11 +30,12 @@ def _dense_attention(q, k, v):
 
 
 def _flash(q, k, v):
-    from ..ops.flash_attention import flash_attention
+    from ..ops.flash_attention import flash_attention, pick_block
 
-    # largest power-of-two block <= 128 that divides T
-    t = q.shape[1]
-    b = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1) if t % b == 0)
+    # explicit attention="flash" engages the kernel at any block size
+    # (minimum=1); shape-adaptive call sites use the default minimum
+    # and fall back to dense instead
+    b = pick_block(q.shape[1], minimum=1)
     return flash_attention(q, k, v, True, None, b, b)
 
 

@@ -104,6 +104,17 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     )
 
 
+def pick_block(t: int, minimum: int = 8) -> Optional[int]:
+    """Largest power-of-two block <= 128 that divides ``t`` — the one
+    block-size policy every flash call site uses. Returns None when the
+    only dividing blocks are smaller than ``minimum`` (callers fall
+    back to dense attention rather than running degenerate tiles)."""
+    for b in (128, 64, 32, 16, 8, 4, 2, 1):
+        if t % b == 0:
+            return b if b >= minimum else None
+    return None
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q: jax.Array,

@@ -107,6 +107,20 @@ def ring_attention(
     return (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
 
 
+def _blockwise_or_full(q, k, v, causal: bool, scale: Optional[float]):
+    """Per-chip attention for the gathered sequence: the pallas flash
+    kernel when the shape tiles (blockwise — the [T, T] score matrix
+    never hits HBM), dense attention otherwise (tiny/odd test shapes;
+    non-causal, which the kernel does not implement). Numerics match
+    full attention up to fp error either way."""
+    from ..ops.flash_attention import flash_attention, pick_block
+
+    b = pick_block(q.shape[1], minimum=8)
+    if b is None or not causal:
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    return flash_attention(q, k, v, causal, scale, b, b)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -116,8 +130,11 @@ def ulysses_attention(
     scale: Optional[float] = None,
 ):
     """DeepSpeed-Ulysses-style all-to-all sequence parallelism under
-    ``shard_map``: re-shard sequence->heads, dense attention, re-shard
-    back. Requires ``H % n == 0``. Per-shard input [B, T/n, H, D]."""
+    ``shard_map``: re-shard sequence->heads, per-chip attention on the
+    full sequence for a head group (the pallas flash kernel when the
+    shape tiles — without it the gathered [T, T] scores are exactly the
+    memory wall sequence parallelism exists to avoid), re-shard back.
+    Requires ``H % n == 0``. Per-shard input [B, T/n, H, D]."""
     n = lax.psum(1, axis_name)
     if q.shape[2] % n:
         raise ValueError(
@@ -136,7 +153,7 @@ def ulysses_attention(
         )
 
     qg, kg, vg = a2a(q, True), a2a(k, True), a2a(v, True)
-    og = full_attention(qg, kg, vg, causal=causal, scale=scale)
+    og = _blockwise_or_full(qg, kg, vg, causal=causal, scale=scale)
     return a2a(og, False)
 
 
