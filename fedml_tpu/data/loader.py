@@ -214,6 +214,12 @@ def _standin_shape_and_sizes(args, name: str):
     if name in ("imagenet", "gld23k", "gld160k"):
         hw = int(getattr(args, "image_size", 64) or 64)
         shape = (hw, hw, 3)
+    if task == "nwp" and getattr(args, "seq_len", None):
+        # args.seq_len drives the stand-in sequence length (real copies
+        # keep their own; the model's max_len already follows args) —
+        # without this the long-context path would silently train at
+        # the dataset's canonical length (shakespeare: 80)
+        shape = (int(args.seq_len),)
     train_n = int(getattr(args, "synthetic_train_size", min(train_n, 20000)))
     test_n = int(getattr(args, "synthetic_test_size", min(test_n, 4000)))
     return shape, class_num, train_n, test_n, task

@@ -105,6 +105,14 @@ class TestSimulationExamples:
         )
         assert "FINAL:" in r.stdout
 
+    def test_longcontext_one_line_8_devices(self):
+        d = os.path.join(EXAMPLES, "longcontext", "one_line")
+        r = _run(
+            [sys.executable, "main.py", "--cf", "fedml_config.yaml"],
+            cwd=d, env=_env(devices=8), timeout=580,
+        )
+        assert "FINAL:" in r.stdout
+
 
 class TestCrossSiloExample:
     def test_server_two_clients_grpc(self, tmp_path):
