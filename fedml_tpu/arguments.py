@@ -116,7 +116,12 @@ _DEFAULTS: Dict[str, Any] = {
     "mesh_shape": None,
     # capture an XLA device trace (tensorboard/perfetto) for the run
     "profile_dir": None,
-    "sp_strategy": "ring",  # or "ulysses"
+    # sequence-parallel strategy: "ring" or "ulysses"
+    "sp_strategy": "ring",
+    # ring attention: chunk each hop's K/V shard so the per-chip score
+    # panel is [Tq, sp_ring_block] instead of [Tq, T/sp] — the memory
+    # knob for very long resident shards (0 = whole shard per hop)
+    "sp_ring_block": 0,
     # rematerialize transformer blocks (jax.checkpoint): trade FLOPs
     # for HBM — recompute block activations in the backward pass
     "remat": False,

@@ -335,9 +335,11 @@ class DistributedTrainer:
         sp = self.mesh.shape["sp"]
         has_dp = "dp" in self.mesh.axis_names
         strategy = str(getattr(self.args, "sp_strategy", "ring") or "ring")
+        ring_bk = getattr(self.args, "sp_ring_block", None)
         attn = make_sequence_sharded_attention(
             self.mesh, strategy=strategy, causal=True,
             batch_axis="dp" if has_dp else None,
+            ring_block_k=int(ring_bk) if ring_bk else None,
         )
         sp_module = module.clone(attn_fn=attn)
         self.model = dataclasses.replace(self.model, module=sp_module)

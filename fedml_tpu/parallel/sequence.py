@@ -54,6 +54,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    block_k: Optional[int] = None,
 ):
     """Blockwise ring attention under ``shard_map``.
 
@@ -61,6 +62,14 @@ def ring_attention(
     contiguously along ``axis_name`` (shard i holds positions
     [i*T/n, (i+1)*T/n)). K/V blocks travel the ring; the online softmax
     accumulates exactly the full-attention result.
+
+    ``block_k`` chunks each hop's K/V shard for the score computation:
+    the per-chip panel shrinks from [B, H, Tq, Tk] to [B, H, Tq, bk]
+    (the same online-softmax fold, just more steps — bitwise-identical
+    math in f32), so per-chip attention memory is O(Tq x bk) no matter
+    how long the resident shard is. Default (None) folds the whole
+    shard per hop. Pure ``lax.scan``, so autodiff needs no custom
+    backward.
     """
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -69,35 +78,59 @@ def ring_attention(
     scale = scale or (D**-0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    bk = int(block_k) if block_k else Tk
+    if bk <= 0 or Tk % bk:
+        raise ValueError(
+            f"ring block_k={bk} must be a positive divisor of the K/V "
+            f"shard length {Tk}"
+        )
+    n_chunks = Tk // bk
+
     q_pos = my_idx * Tq + jnp.arange(Tq)  # global query positions
 
-    def step(carry, i):
-        o, m, l, k_cur, v_cur = carry
-        src = (my_idx - i) % n  # owner of the block we currently hold
-        # scores and the online-softmax state accumulate in f32 even for
-        # bf16 inputs — l sums T terms and bf16's 8 mantissa bits drift
+    def fold(acc, k_chunk, v_chunk, k_pos):
+        """Fold one [bk] K/V chunk into the online-softmax state.
+        Scores and the state accumulate in f32 even for bf16 inputs —
+        l sums T terms and bf16's 8 mantissa bits drift."""
+        o, m, l = acc
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32
-        ) * scale  # [B,H,Tq,Tk] f32
+            "bqhd,bkhd->bhqk", q, k_chunk, preferred_element_type=jnp.float32
+        ) * scale  # [B,H,Tq,bk] f32
         if causal:
-            k_pos = src * Tk + jnp.arange(Tk)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, _NEG_INF)
         s_max = s.max(axis=-1)  # [B,H,Tq]
         m_new = jnp.maximum(m, s_max)
         # renormalize the running state to the new max
         correction = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])  # [B,H,Tq,Tk]
+        p = jnp.exp(s - m_new[..., None])  # [B,H,Tq,bk]
         l_new = l * correction + p.sum(axis=-1)
         pv = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+            "bhqk,bkhd->bqhd", p, v_chunk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
         o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+        return o_new, m_new, l_new
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (my_idx - i) % n  # owner of the block we currently hold
+        base = src * Tk
+
+        if n_chunks == 1:
+            o, m, l = fold((o, m, l), k_cur, v_cur, base + jnp.arange(Tk))
+        else:
+
+            def inner(acc, j):
+                kc = lax.dynamic_slice_in_dim(k_cur, j * bk, bk, axis=1)
+                vc = lax.dynamic_slice_in_dim(v_cur, j * bk, bk, axis=1)
+                return fold(acc, kc, vc, base + j * bk + jnp.arange(bk)), None
+
+            (o, m, l), _ = lax.scan(inner, (o, m, l), jnp.arange(n_chunks))
         # rotate KV one hop around the ring
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+        return (o, m, l, k_nxt, v_nxt), None
 
     o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
@@ -159,7 +192,7 @@ def ulysses_attention(
 
 def make_sequence_sharded_attention(
     mesh, strategy: str = "ring", causal: bool = True, axis_name: str = "sp",
-    batch_axis: str = None,
+    batch_axis: str = None, ring_block_k: Optional[int] = None,
 ):
     """Wrap a strategy as a [B, T, H, D] -> [B, T, H, D] function whose
     sequence axis is sharded over ``mesh[axis_name]`` via shard_map —
@@ -179,6 +212,15 @@ def make_sequence_sharded_attention(
         )
     fn = strategies[strategy]
     inner = functools.partial(fn, axis_name=axis_name, causal=causal)
+    if ring_block_k:
+        if strategy != "ring":
+            # refuse loudly: the user tuned a memory cap that this
+            # strategy would silently not honor
+            raise ValueError(
+                f"sp_ring_block={ring_block_k} only applies to "
+                f"sp_strategy 'ring', not {strategy!r}"
+            )
+        inner = functools.partial(inner, block_k=ring_block_k)
     spec = P(batch_axis, axis_name, None, None)
 
     return shard_map(

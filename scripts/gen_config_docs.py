@@ -180,8 +180,8 @@ GROUPS = [
     ]),
     ("Defense", ["defense_type", "norm_bound", "stddev"]),
     ("Parallelism (mesh / distributed)", [
-        "mesh_shape", "sp_strategy", "pp_microbatches", "moe_aux_weight",
-        "grad_accum_steps", "matmul_precision",
+        "mesh_shape", "sp_strategy", "sp_ring_block", "pp_microbatches",
+        "moe_aux_weight", "grad_accum_steps", "matmul_precision",
     ]),
     ("Device", ["using_gpu", "device_type", "gpu_mapping_file"]),
     ("Validation & tracking", [
