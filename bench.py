@@ -16,10 +16,10 @@ same jitted per-client step so the comparison isolates architecture).
   ResNet-18(GN)/CIFAR-10-shape, 10/round, bf16) with samples/s/chip
   and ``mfu_vs_bf16_peak`` — the MFU figure that means something (the
   tiny-CNN headline is latency-bound by design);
-- ``scaling``: 8->256 simulated-client sweep — cohort size vs rounds/s
+- ``scaling``: 8->512 simulated-client sweep — cohort size vs rounds/s
   and client samples/s. ``throughput_retention_vs_base`` = sps(C)/sps(base):
   on a single chip, ~1.0 means the vectorized engine keeps the chip
-  saturated as the cohort grows 32x (cohorts are compute-bound, not
+  saturated as the cohort grows 64x (cohorts are compute-bound, not
   dispatch-bound); ``per_client_efficiency`` is the strong-scaling view
   (per-client throughput vs the 8-client cohort — bounded by 8/C once
   one chip saturates; >8/C headroom requires more chips, which is what
@@ -588,7 +588,11 @@ _DENSE_TIMEOUT_S = 170.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _SWEEP_TIMEOUT_S = 90.0
-_SWEEP_COHORTS = [8, 32, 256]
+# 512 became feasible when stand-in cohorts moved on-device (the
+# cohort is a compute knob now, not a transfer one; 1024 would push
+# the vmapped cohort's activations toward the 16 GB HBM ceiling). It
+# stays last so budget pressure sheds it first.
+_SWEEP_COHORTS = [8, 32, 256, 512]
 _LATE_PROBE_TIMEOUT_S = 60.0
 # after any TPU phase times out, the tunnel may be wedged (observed:
 # every later backend init hangs, even jax.devices()). A quick probe

@@ -91,6 +91,7 @@ class TestDeviceSynth:
         sizes = list(ds.train_data_local_num_dict.values())
         assert max(sizes) - min(sizes) <= 1
 
+    @pytest.mark.slow  # 3 full training rounds, ~30s on a 1-core box
     def test_learnable_cnn_loss_drops(self):
         from fedml_tpu.simulation import FedAvgAPI
 
@@ -105,6 +106,7 @@ class TestDeviceSynth:
 
 
 class TestEnsureFloat:
+    @pytest.mark.slow  # full ResNet-18 init + forward, ~19s on 1 core
     def test_resnet_preserves_bf16(self):
         import jax
         import jax.numpy as jnp
