@@ -1,9 +1,9 @@
 #!/usr/bin/env bash
 # Fast gate: smoke tier minus the slow tail — tests measured >4s carry
-# pytest.mark.slow and run only in the full tier. Measured: 112 tests
-# in ~67s cold on a 1-core worker (~30s of that is jax import +
-# collection; under 60s on any multi-core box). Re-measure with
-# --durations=40 and re-tier when the gate drifts.
+# pytest.mark.slow and run only in the full tier. Measured (round 4,
+# after re-tiering): 116 tests in ~85s cold on a 1-core worker (~30s of
+# that is jax import + collection; under 60s on any multi-core box).
+# Re-measure with --durations=40 and re-tier when the gate drifts.
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -m "smoke and not slow" -q "$@"

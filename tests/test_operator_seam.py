@@ -116,6 +116,7 @@ def _sp_run(args_factory, client_trainer=None, server_aggregator=None, **kw):
 
 
 class TestSimulationSeam:
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_default_trainer_is_stock_engine(self, args_factory):
         stock = _sp_run(args_factory)
         via_seam = _sp_run(args_factory, client_trainer=DefaultClientTrainer)
@@ -128,6 +129,7 @@ class TestSimulationSeam:
         )
         assert _params_equal(init_params, api.global_params)
 
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_halfstep_trainer_changes_training(self, args_factory):
         stock = _sp_run(args_factory)
         half = _sp_run(args_factory, client_trainer=HalfStepTrainer)
@@ -179,6 +181,7 @@ class TestSimulationSeam:
                 server_aggregator=GlobalKeepAggregator(model, args),
             )
 
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_imperative_train_advances_rng_per_call(self, args_factory):
         """Round N and round N+1 must not replay the same shuffle."""
         args = _mk(args_factory, epochs=2, shuffle=True)
@@ -227,6 +230,7 @@ class TestCrossSiloSeam:
         assert not any(t.is_alive() for t in threads)
         return server
 
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_frozen_trainer_freezes_cross_silo(self, args_factory):
         server = self._run_world(
             args_factory, "seam_frozen", client_trainer_cls=FrozenTrainer

@@ -106,6 +106,7 @@ class TestPipeline:
             np.asarray(out.reshape(seq.shape)), np.asarray(seq), atol=1e-5
         )
 
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_shape_validation(self):
         stage_fn, per_stage, x = _stages_and_input()
         with pytest.raises(ValueError, match="microbatches"):

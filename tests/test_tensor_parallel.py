@@ -58,6 +58,7 @@ def _train_step(model, opt):
 
 
 class TestSpecs:
+    @pytest.mark.slow  # >4s on the 1-core gate box; full tier
     def test_megatron_layout(self):
         _, params, _ = _model_and_batch()
         specs = tp_specs(params)
