@@ -86,6 +86,31 @@ class TestDeviceSynth:
         assert "stand-in" not in caplog.text
         assert ds.train_data_num > 0
 
+    def test_truncation_keeps_metadata_consistent(self, caplog):
+        """A skewed partition whose tail exceeds the waste cap: the
+        packer warns (no silent caps) and every count in the dataset
+        object reflects the packed reality — train_data_num, the
+        per-client dict, packed_num_samples, and the global view's
+        mask all agree."""
+        import logging
+
+        args = _args(
+            synthetic_train_size=2000,
+            client_num_in_total=8,
+            partition_alpha=0.1,  # heavy skew
+            # nb clamps to the median client's batches, so any client
+            # above the median is guaranteed to lose its tail
+            packing_waste_cap=1.0,
+        )
+        with caplog.at_level(logging.WARNING):
+            ds = load(args)
+        packed_total = int(np.asarray(ds.packed_num_samples).sum())
+        assert ds.train_data_num == packed_total
+        assert sum(ds.train_data_local_num_dict.values()) == packed_total
+        assert float(np.asarray(ds.train_data_global.mask).sum()) == packed_total
+        assert packed_total < 2000  # the cap bit (median clamp)
+        assert "long-tail truncation" in caplog.text
+
     def test_homo_partition_supported(self):
         ds = load(_args(partition_method="homo"))
         sizes = list(ds.train_data_local_num_dict.values())
