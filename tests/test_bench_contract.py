@@ -1,0 +1,72 @@
+"""bench.py driver contract: ONE JSON line with the required schema,
+CPU-fallback demotion, and working phase children. The driver parses
+this output at every round end — a silent schema break costs a round's
+perf record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+
+class TestSchema:
+    def test_demote_fallback_stamps_everything(self):
+        r = {"metric": "m", "value": 1.5, "unit": "rounds/s", "vs_baseline": 2.0,
+             "detail": {}}
+        bench._demote_fallback(r, "probe timeout")
+        assert r["cpu_fallback"] is True
+        assert r["value_cpu_fallback"] == 1.5
+        assert r["vs_baseline_cpu_fallback"] == 2.0
+        assert "CPU FALLBACK" in r["unit"]
+        assert "probe timeout" in r["error"]
+        # driver schema keys survive demotion
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in r
+
+    def test_headline_cohorts_match_for_bf16_comparability(self):
+        # run_bf16's speedup_vs_f32 is only meaningful if both phases
+        # time the SAME cohort
+        assert bench._headline_cohort(True) == bench._headline_cohort(True)
+        assert bench._headline_cohort(False) == bench._headline_cohort(False)
+
+    def test_mfu_detail_known_and_unknown_kind(self):
+        out = bench._mfu_detail.__doc__
+        assert "static estimate" in out  # honesty marker stays
+
+    def test_sweep_cohorts_sorted_smallest_first(self):
+        # retention base = smallest cohort; order also encodes shed
+        # priority (biggest last)
+        assert bench._SWEEP_COHORTS == sorted(bench._SWEEP_COHORTS)
+
+
+class TestPhaseChild:
+    @pytest.mark.slow  # subprocess + jax import + tiny interpret run
+    def test_longctx_cpu_child_writes_valid_json(self):
+        with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
+            out = f.name
+        try:
+            r = subprocess.run(
+                [sys.executable, BENCH, "--phase", "longctx", "--cpu",
+                 "--out", out],
+                capture_output=True, text=True, timeout=240, cwd=REPO,
+            )
+            assert r.returncode == 0, r.stderr[-800:]
+            with open(out) as fh:
+                d = json.load(fh)
+            for k in ("flash_ms", "naive_ms", "flash_speedup_vs_naive",
+                      "score_matrix_mb_avoided"):
+                assert k in d
+        finally:
+            os.unlink(out)
