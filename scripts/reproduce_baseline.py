@@ -39,8 +39,18 @@ def main() -> None:
     p.add_argument("--rounds", type=int, default=200)
     p.add_argument("--data-cache-dir", default="./fedml_data")
     p.add_argument("--test-freq", type=int, default=10)
+    p.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU backend (a wedged/absent accelerator "
+        "otherwise hangs jax backend init indefinitely)",
+    )
     a = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+
+    if a.cpu:
+        from __graft_entry__ import _force_virtual_cpu
+
+        _force_virtual_cpu(1)
 
     import fedml_tpu
     from fedml_tpu import models
