@@ -509,7 +509,15 @@ def load(args) -> FederatedDataset:
 
     import jax.numpy as jnp
 
-    x_dtype = jnp.int32 if task == "nwp" else jnp.float32
+    # float features follow args.dtype, matching the device-synth path
+    # (_device_synth_classification) so stand-in and real-data runs of
+    # the same config see identical input precision (advisor r4)
+    if task == "nwp":
+        x_dtype = jnp.int32
+    elif str(getattr(args, "dtype", "float32") or "float32") == "bfloat16":
+        x_dtype = jnp.bfloat16
+    else:
+        x_dtype = jnp.float32
 
     waste_cap = float(getattr(args, "packing_waste_cap", 4.0) or 4.0)
     sizes = [len(x) for x in xs_tr]

@@ -17,7 +17,12 @@ Data strategy (in order):
    trajectory, not an MNIST-scale reproduction.
 
 Prints one JSON line: achieved final/best test acc, the 81.9 target,
-and which data source actually backed the run.
+and which data source actually backed the run. A centralized-training
+anchor (``fedml_tpu.centralized.CentralizedTrainer``, the repo's CI
+oracle) runs on the IDENTICAL data afterward, so on the subset — where
+the 81.9 MNIST target is not comparable — the federated number is
+interpretable as "within X pp of centralized on the same real data"
+(VERDICT r4 next #3).
 
 Usage:
     python scripts/reproduce_baseline.py [--rounds N] [--data-cache-dir D]
@@ -39,6 +44,15 @@ def main() -> None:
     p.add_argument("--rounds", type=int, default=200)
     p.add_argument("--data-cache-dir", default="./fedml_data")
     p.add_argument("--test-freq", type=int, default=10)
+    p.add_argument(
+        "--centralized-epochs", type=int, default=-1,
+        help="epoch budget for the centralized anchor on the same data "
+        "(0 disables; -1 = auto: 40 on the digits subset where the 81.9 "
+        "MNIST target is not comparable, 0 on full MNIST where it is "
+        "and 40 epochs x 60k samples would waste hours on this box). "
+        "The anchor makes the subset accuracy interpretable "
+        "(federated-vs-centralized gap).",
+    )
     p.add_argument(
         "--cpu", action="store_true",
         help="force the CPU backend (a wedged/absent accelerator "
@@ -114,21 +128,46 @@ def main() -> None:
     final = api.train()
 
     best = max((h.get("test_acc", 0.0) for h in api.history), default=0.0)
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_lr_fedavg_test_acc",
-                "data_source": source,
-                "real_data": True,
-                "rounds": int(a.rounds),
-                "final_test_acc_pct": round(100 * final.get("test_acc", 0.0), 2),
-                "best_test_acc_pct": round(100 * best, 2),
-                "baseline_acc_pct": BASELINE_ACC,
-                "comparable_to_baseline": full_mnist,
-            }
-        ),
-        flush=True,
+    out = {
+        "metric": "mnist_lr_fedavg_test_acc",
+        "data_source": source,
+        "real_data": True,
+        "rounds": int(a.rounds),
+        "final_test_acc_pct": round(100 * final.get("test_acc", 0.0), 2),
+        "best_test_acc_pct": round(100 * best, 2),
+        "baseline_acc_pct": BASELINE_ACC,
+        "comparable_to_baseline": full_mnist,
+    }
+
+    anchor_epochs = (
+        (0 if full_mnist else 40)
+        if a.centralized_epochs < 0
+        else a.centralized_epochs
     )
+    if anchor_epochs > 0:
+        # centralized anchor on the IDENTICAL dataset object: the same
+        # jitted trainer the clients use, pointed at the global split
+        # (centralized.py). Fresh Arguments so the federated run's
+        # round config cannot leak into the anchor.
+        from fedml_tpu.centralized import CentralizedTrainer
+
+        cargs = Arguments()
+        for k, v in cfg.items():
+            setattr(cargs, k, v)
+        cargs.epochs = int(anchor_epochs)
+        cargs._validate()
+        cmodel = models.create(cargs, dataset.class_num)
+        trainer = CentralizedTrainer(cargs, None, dataset, cmodel)
+        cfinal = trainer.train()
+        cbest = max((h.get("test_acc", 0.0) for h in trainer.history), default=0.0)
+        out["centralized_anchor"] = {
+            "epochs": int(anchor_epochs),
+            "final_test_acc_pct": round(100 * cfinal.get("test_acc", 0.0), 2),
+            "best_test_acc_pct": round(100 * cbest, 2),
+        }
+        out["federated_minus_centralized_pp"] = round(100 * (best - cbest), 2)
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

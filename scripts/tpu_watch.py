@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""TPU tunnel watcher: capture bench phases the moment the tunnel answers.
+
+The axon TPU tunnel is intermittent (round 4: one 9-minute window in
+~12 hours). A round-end ``bench.py`` run landing in a wedged window
+demotes to CPU fallback, so TPU numbers exist only if someone happens
+to run the bench inside a live window. This watcher removes the luck:
+
+  python scripts/tpu_watch.py --hours 10.5 &
+
+- probes the tunnel every ``--interval`` seconds (subprocess, bounded);
+- on a live window, runs the UNCAPTURED ``bench.py --phase`` children in
+  priority order (dense MFU first — the round-5 deliverable — then
+  longctx, bf16, headline, scaling sweep);
+- appends each result to ``BENCH_TPU_CAPTURE_r05.json`` immediately
+  (atomic tmp+rename), stamped with UTC time and attempt count, so a
+  window that closes mid-sweep loses only the phase in flight;
+- a phase that times out marks the tunnel suspect; a quick wedge probe
+  decides whether to keep spending the window (same policy as
+  bench.py's round-end run, bench.py:699-719);
+- exits when every phase is captured, the deadline passes, or a
+  ``.tpu_watch_stop`` file appears at the repo root (used to guarantee
+  the 1-core box is quiet before round-end certification).
+
+bench.py reads the capture file when its own round-end run falls back
+to CPU, so the driver's BENCH_r05.json carries the TPU numbers either
+way (see bench.py _attach_capture_sidecar).
+"""
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402  — reuses _child_env (compile cache) + probe code
+
+CAPTURE_PATH = os.path.join(_REPO, bench._CAPTURE_BASENAME)
+STOP_FILE = os.path.join(_REPO, ".tpu_watch_stop")
+LOG_PATH = os.path.join(_REPO, "tpu_watch.log")
+
+# Priority order = information value per VERDICT r4 "Next round" #1:
+# dense MFU has never been measured on TPU in four rounds; longctx is
+# the flash kernel's reason to exist; bf16/headline next; the sweep
+# cohorts last (32 was observed but lost to a short window in r4).
+# Windows are generous — the watcher owns hours, not bench's 580 s —
+# and sized for first-compile-on-TPU (ResNet cohort: minutes).
+PHASES = [
+    ("dense", ["--phase", "dense"], 600.0),
+    ("longctx", ["--phase", "longctx"], 420.0),
+    ("bf16", ["--phase", "bf16"], 300.0),
+    ("headline", ["--phase", "headline"], 420.0),
+    ("sweep_8", ["--phase", "sweep", "--cohort", "8"], 180.0),
+    ("sweep_32", ["--phase", "sweep", "--cohort", "32"], 180.0),
+    ("sweep_128", ["--phase", "sweep", "--cohort", "128"], 240.0),
+    ("sweep_256", ["--phase", "sweep", "--cohort", "256"], 300.0),
+    ("sweep_512", ["--phase", "sweep", "--cohort", "512"], 360.0),
+]
+MAX_ATTEMPTS = 3  # per phase, each in a fresh window
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def _log(msg: str) -> None:
+    line = f"[tpu_watch {_utcnow()}] {msg}"
+    print(line, flush=True)
+    with open(LOG_PATH, "a") as fh:
+        fh.write(line + "\n")
+
+
+def _load_capture() -> dict:
+    if os.path.exists(CAPTURE_PATH):
+        try:
+            with open(CAPTURE_PATH) as fh:
+                return json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            pass
+    return {
+        "provenance": (
+            "Automated capture by scripts/tpu_watch.py (round 5): probes "
+            "the intermittent axon tunnel all round and runs each "
+            "bench.py phase in the first live window it gets. Each entry "
+            "is stamped with its own UTC capture time."
+        ),
+        "phases": {},
+        "attempts": {},
+    }
+
+
+def _save_capture(cap: dict) -> None:
+    fd, tmp = tempfile.mkstemp(dir=_REPO, suffix=".tmp")
+    with os.fdopen(fd, "w") as fh:
+        json.dump(cap, fh, indent=2)
+    os.replace(tmp, CAPTURE_PATH)
+
+
+def _probe(timeout_s: float) -> bool:
+    ok, note = bench._probe_tpu(timeout_s=timeout_s, attempts=1)
+    if not ok:
+        _log(f"probe: down ({note})")
+    return ok
+
+
+def _run_phase(name: str, phase_args: list, timeout_s: float):
+    """(result|None, note) — mirrors bench._run_phase_subprocess but
+    keeps partial child output (longctx flushes per-variant)."""
+    with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
+        out_path = f.name
+    cmd = [sys.executable, os.path.join(_REPO, "bench.py")] + phase_args + [
+        "--out", out_path,
+    ]
+    note = "ok"
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            env=bench._child_env(), cwd=_REPO,
+        )
+        for line in (r.stderr or "").splitlines()[-8:]:
+            _log(f"  child: {line}")
+        if r.returncode != 0:
+            tail = (r.stderr or r.stdout or "").strip().splitlines()[-1:]
+            note = f"rc={r.returncode}: {tail[0] if tail else ''}"
+    except subprocess.TimeoutExpired:
+        note = f"timeout after {timeout_s:.0f}s"
+    except Exception as e:  # noqa: BLE001
+        note = f"{type(e).__name__}: {e}"
+    try:
+        with open(out_path) as fh:
+            result = json.load(fh)
+        if note != "ok" and isinstance(result, dict):
+            result["partial_note"] = note  # child died after a flush
+    except (json.JSONDecodeError, OSError):
+        result = None
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    return result, note
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--hours", type=float, default=10.5)
+    p.add_argument("--interval", type=float, default=480.0)
+    p.add_argument("--probe-timeout", type=float, default=75.0)
+    args = p.parse_args()
+    deadline = time.time() + args.hours * 3600
+
+    cap = _load_capture()
+    _log(
+        f"start: deadline in {args.hours}h, "
+        f"captured={sorted(cap['phases'])}, stop-file={STOP_FILE}"
+    )
+
+    while time.time() < deadline:
+        if os.path.exists(STOP_FILE):
+            _log("stop file found — exiting")
+            return
+        pending = [
+            (n, a, t)
+            for n, a, t in PHASES
+            if n not in cap["phases"]
+            and cap["attempts"].get(n, 0) < MAX_ATTEMPTS
+        ]
+        if not pending:
+            _log("all phases captured (or out of attempts) — exiting")
+            return
+
+        if not _probe(args.probe_timeout):
+            time.sleep(args.interval)
+            continue
+
+        _log(f"tunnel UP — pending: {[n for n, _, _ in pending]}")
+        for name, phase_args, timeout_s in pending:
+            if os.path.exists(STOP_FILE):
+                _log("stop file found mid-window — exiting")
+                return
+            if time.time() > deadline:
+                _log("deadline passed mid-window — exiting")
+                return
+            cap["attempts"][name] = cap["attempts"].get(name, 0) + 1
+            _save_capture(cap)
+            t0 = time.time()
+            _log(f"phase {name} (attempt {cap['attempts'][name]}) ...")
+            result, note = _run_phase(name, phase_args, timeout_s)
+            dt = time.time() - t0
+            if result is not None:
+                cap["phases"][name] = {
+                    "captured_at": _utcnow(),
+                    "wall_s": round(dt, 1),
+                    "attempt": cap["attempts"][name],
+                    "result": result,
+                }
+                _save_capture(cap)
+                _log(f"phase {name}: CAPTURED in {dt:.0f}s")
+            else:
+                _log(f"phase {name}: failed ({note})")
+                if note.startswith("timeout after"):
+                    # wedge check before burning the next phase window
+                    if not _probe(20.0):
+                        _log("tunnel wedged mid-window — back to sleep")
+                        break
+        time.sleep(30)  # brief settle, then re-probe for remaining phases
+
+    _log("deadline reached — exiting")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,102 @@ class TestCLI:
 
 
 class TestEdgeAgent:
+    def test_config_rewrite_status_and_orphan_reaping(self, tmp_path):
+        """The three FedMLClientRunner behaviors beyond spawn/kill
+        (login.py:139-210 config rewrite, report_client_training_status,
+        :372-441 stale-process cleanup): start a package whose config
+        the agent must rewrite, observe the status stream, crash the
+        agent (children survive), restart it, and see the orphan reaped.
+        """
+        import yaml
+
+        from fedml_tpu.core.comm.broker import Broker, BrokerClient
+        from fedml_tpu.edge_agent import EdgeAgent
+
+        # package: entry dumps its --cf contents to prove the rewrite
+        # reached the child, then sleeps (so it can be orphaned)
+        src = tmp_path / "src"
+        src.mkdir()
+        seen_cfg = tmp_path / "seen_config.yaml"
+        (src / "main.py").write_text(
+            "import argparse, shutil, time\n"
+            "p = argparse.ArgumentParser()\n"
+            "p.add_argument('--cf')\n"
+            f"shutil.copy(p.parse_args().cf, {str(seen_cfg)!r})\n"
+            "time.sleep(120)\n"
+        )
+        cfg = tmp_path / "cfg"
+        cfg.mkdir()
+        (cfg / "fedml_config.yaml").write_text(
+            "common_args: {run_id: '${FEDSYS.RUN_ID}'}\n"
+            "data_args: {data_cache_dir: '${FEDSYS.DATA_CACHE_DIR}'}\n"
+            "train_args: {client_id_list: '${FEDSYS.CLIENT_ID_LIST}',\n"
+            "             learning_rate: 0.5}\n"
+        )
+        assert cli_main(
+            ["build", "-t", "client", "-sf", str(src), "-ep", "main.py",
+             "-cf", str(cfg), "-df", str(tmp_path / "dist")]
+        ) == 0
+        pkg = tmp_path / "dist" / "fedml_client_package.zip"
+
+        broker = Broker()
+        state_dir = str(tmp_path / "agent_state")
+        agent = EdgeAgent("acctY", broker.host, broker.port, state_dir=state_dir)
+        sub = BrokerClient(broker.host, broker.port)
+        statuses = []
+        sub.subscribe(
+            agent.status_topic("9"),
+            lambda _t, p: statuses.append(json.loads(p.decode())),
+        )
+        pub = BrokerClient(broker.host, broker.port)
+        time.sleep(0.05)
+        pub.publish(
+            agent.topic("start"),
+            json.dumps(
+                {
+                    "run_id": "9",
+                    "package_path": str(pkg),
+                    "client_id_list": [3, 7],
+                    "config_overrides": {"train_args": {"learning_rate": 0.9}},
+                }
+            ).encode(),
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline and not seen_cfg.exists():
+            time.sleep(0.1)
+        assert seen_cfg.exists(), "rewritten config never reached the entry"
+        got = yaml.safe_load(seen_cfg.read_text())
+        assert got["common_args"]["run_id"] == "9"
+        assert os.path.isdir(got["data_args"]["data_cache_dir"])
+        assert json.loads(got["train_args"]["client_id_list"]) == [3, 7]
+        assert got["train_args"]["learning_rate"] == 0.9  # override won
+
+        deadline = time.time() + 10
+        while time.time() < deadline and len(statuses) < 2:
+            time.sleep(0.05)
+        assert [s["status"] for s in statuses[:2]] == ["STARTING", "RUNNING"]
+        assert all(s["edge_id"] == "acctY" for s in statuses)
+
+        # crash the agent (children survive) — the registry remembers
+        orphan = agent.runs["9"]
+        agent.shutdown(reap=False)
+        assert orphan.poll() is None, "child must outlive the crashed agent"
+        with open(os.path.join(state_dir, "runs.json")) as f:
+            assert "9" in json.load(f)
+
+        # restarted incarnation reaps the orphan before serving
+        agent2 = EdgeAgent("acctY", broker.host, broker.port, state_dir=state_dir)
+        deadline = time.time() + 10
+        while time.time() < deadline and orphan.poll() is None:
+            time.sleep(0.1)
+        assert orphan.poll() is not None, "orphan not reaped on restart"
+        with open(os.path.join(state_dir, "runs.json")) as f:
+            assert json.load(f) == {}
+        agent2.shutdown()
+        sub.close()
+        pub.close()
+        broker.stop()
+
     def test_start_and_stop_run(self, tmp_path):
         from fedml_tpu.core.comm.broker import Broker, BrokerClient
         from fedml_tpu.edge_agent import EdgeAgent
@@ -82,7 +178,10 @@ class TestEdgeAgent:
         pkg = tmp_path / "dist" / "fedml_client_package.zip"
 
         broker = Broker()
-        agent = EdgeAgent("acctX", broker.host, broker.port)
+        agent = EdgeAgent(
+            "acctX", broker.host, broker.port,
+            state_dir=str(tmp_path / "agent_state"),
+        )
         pub = BrokerClient(broker.host, broker.port)
         time.sleep(0.05)
         pub.publish(
