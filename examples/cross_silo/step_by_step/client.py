@@ -1,0 +1,29 @@
+"""Cross-silo FL client, stage by stage (reference:
+...grpc_fedavg_mnist_lr_example/step_by_step/torch_client.py).
+
+Run:  python client.py --cf fedml_config.yaml --rank <1..N>
+"""
+
+import fedml_tpu
+from fedml_tpu import data, device, models
+from fedml_tpu.core.tracking import device_trace
+from fedml_tpu.cross_silo import Client
+
+if __name__ == "__main__":
+    # 1. init: parse --cf yaml + --rank into typed Arguments
+    args = fedml_tpu.init()
+
+    # 2. device
+    dev = device.get_device(args)
+
+    # 3. data: this silo's shard (rank indexes the partition)
+    dataset = data.load(args)
+
+    # 4. model
+    model = models.create(args, dataset.class_num)
+
+    # 5. runner: connect, train on request, ship updates (swap in a
+    #    custom ClientTrainer via Client(..., client_trainer=...))
+    client = Client(args, dev, dataset, model)
+    with device_trace(args):
+        client.run()

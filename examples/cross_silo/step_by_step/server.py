@@ -1,0 +1,32 @@
+"""Cross-silo FL server, stage by stage (reference teaching surface:
+python/examples/cross_silo/grpc_fedavg_mnist_lr_example/step_by_step/
+torch_server.py — init / device / data / model / runner as explicit
+user-visible stages instead of the one_line wrapper).
+
+Run:  python server.py --cf fedml_config.yaml --rank 0
+"""
+
+import fedml_tpu
+from fedml_tpu import data, device, models
+from fedml_tpu.core.tracking import device_trace
+from fedml_tpu.cross_silo import Server
+
+if __name__ == "__main__":
+    # 1. init: parse --cf yaml + --rank into typed Arguments
+    args = fedml_tpu.init()
+
+    # 2. device: the jax device this process trains/aggregates on
+    dev = device.get_device(args)
+
+    # 3. data: load + partition + pack onto the device
+    dataset = data.load(args)
+
+    # 4. model: factory keyed on model_args.model
+    model = models.create(args, dataset.class_num)
+
+    # 5. runner: gRPC server loop — presence handshake, cohort
+    #    selection, aggregation (swap in a custom ServerAggregator via
+    #    Server(..., server_aggregator=...) to override aggregation)
+    server = Server(args, dev, dataset, model)
+    with device_trace(args):
+        server.run()

@@ -1,0 +1,39 @@
+"""Mesh-parallel (distributed) LM training, stage by stage.
+
+The one_line wrapper (``fedml_tpu.run_distributed()``) does exactly
+these five stages; spelling them out is the integration surface — each
+object can be replaced or inspected before the next stage consumes it.
+(Reference analog: the step_by_step example tier,
+python/examples/cross_silo/.../step_by_step/; the reference has no
+mesh-parallel platform to give this treatment to.)
+
+Run:  python main.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu
+from fedml_tpu import data, device, models
+from fedml_tpu.distributed import DistributedTrainer
+
+if __name__ == "__main__":
+    # 1. init: parse --cf yaml into typed Arguments. mesh_args picks
+    #    the parallelism: {"dp": 8}, {"dp": 2, "sp": 4}, {"pp": 4},
+    #    {"dp": 2, "tp": 2, "ep": 2}, ...
+    args = fedml_tpu.init()
+
+    # 2. device: under a mesh the trainer owns placement; this is the
+    #    process-local default device
+    dev = device.get_device(args)
+
+    # 3. data: global batches; the trainer shards them onto the mesh
+    #    (batch axis -> dp, token axis -> sp)
+    dataset = data.load(args)
+
+    # 4. model: a transformer LM with pluggable attention (sp swaps in
+    #    ring / Ulysses attention; pp slices the layer stack)
+    model = models.create(args, dataset.class_num)
+
+    # 5. runner: builds the jax.sharding.Mesh from mesh_args, shards
+    #    params/opt-state/data, jits ONE train step over the mesh, and
+    #    runs the epoch loop (checkpointing + metrics included)
+    trainer = DistributedTrainer(args, dev, dataset, model)
+    print("FINAL:", trainer.run())

@@ -61,6 +61,16 @@ DATASET_ARCHIVES = {
     ),
     "stackoverflow_nwp": _SO_ARCHIVES,
     "stackoverflow_lr": _SO_ARCHIVES,
+    # FeTS2021 training archive (data/FeTS2021/download.sh)
+    "fets2021": (
+        "https://fedcv.s3.us-west-1.amazonaws.com/MICCAI_FeTS2021_TrainingData.zip",
+    ),
+    # real edge-case attack sets — southwest/ardis/howto/greencar
+    # (data/edge_case_examples/get_data.sh); consumed by
+    # poison.load_edge_case_arrays, not the dataset loader
+    "edge_case_examples": (
+        "http://pages.cs.wisc.edu/~hongyiwang/edge_case_attack/edge_case_examples.zip",
+    ),
 }
 
 

@@ -78,6 +78,13 @@ _DATASET_META = {
     "pascal_voc": ((64, 64, 3), 21, 4000, 800, "segmentation"),
     "coco_seg": ((64, 64, 3), 81, 4000, 800, "segmentation"),
     "cityscapes": ((64, 64, 3), 19, 3000, 500, "segmentation"),
+    # FeTS2021 (reference data/FeTS2021/download.sh — the BraTS2018
+    # multimodal brain-MRI federation, partitioned by institution):
+    # 4 modality channels (T1/T1Gd/T2/FLAIR slices), 4 label classes
+    # (background + 3 tumor sub-regions). Stand-in keeps H/W modest; a
+    # real extracted copy under data_cache_dir/fets2021 (train/test
+    # npz or image folders) overrides.
+    "fets2021": ((64, 64, 4), 4, 2000, 400, "segmentation"),
 }
 
 

@@ -5,8 +5,12 @@ Reference: ``data/edge_case_examples/data_loader.py`` (1,156 LoC) —
 ``southwest`` / ``ardis`` / ``howto`` / ``greencar-neo``, :205-488):
 attacker clients train on examples relabelled to a target class, some
 carrying an edge-case (out-of-distribution) or trigger pattern. This
-module reproduces the MECHANISMS generically (the reference's types
-are dataset downloads this environment can't fetch):
+module reproduces the MECHANISMS generically, and ingests the
+reference's REAL edge-case arrays when the downloaded archive
+(``get_data.sh`` -> ``edge_case_examples.zip``) sits under
+``data_cache_dir`` — ``load_edge_case_arrays`` reads the
+southwest/ardis pickles and the ``edge_case`` poison type then uses
+those genuine out-of-distribution images instead of far-tail noise:
 
 - ``label_flip``      — y -> (y + 1) % C  (untargeted poisoning)
 - ``targeted_flip``   — y[source] -> target  (targeted misclassification)
@@ -24,11 +28,93 @@ aggregation defend against (fedavg_robust configs: ``args.poison_type``,
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+import functools
+import logging
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 POISON_TYPES = ("label_flip", "targeted_flip", "backdoor_pattern", "edge_case")
+
+# archive-relative candidates per edge-case kind (reference
+# data_loader.py:393-488 file names): southwest airplanes are
+# CIFAR-shaped 32x32x3 pickled arrays; ARDIS is an MNIST-shaped
+# handwritten-digit set stored as a torch-saved dataset
+_EDGE_CASE_FILES = {
+    "southwest": (
+        "southwest_images_new_train.pkl",
+        "southwest_images_adv_p_percent_edge_case.pkl",
+    ),
+    "ardis": ("ardis_test_dataset.pt", "ARDIS/ardis_test_dataset.pt"),
+    "howto": ("howto_trigger_images.pkl", "saved_datasets/howto_trigger.pkl"),
+    "greencar": ("greencar_images.pkl", "saved_datasets/greencar.pkl"),
+}
+
+
+def _as_nhwc(arr: np.ndarray) -> Optional[np.ndarray]:
+    """Coerce loaded image arrays to float [N, H, W, C] in [0, 1] —
+    the SAME scale every real-data ingestion path uses (ingest.py
+    divides uint8 by 255), so injected edge-case rows sit in the clean
+    data's value range instead of betraying themselves by scale."""
+    a = np.asarray(arr)
+    if a.ndim == 3:  # [N, H, W] grayscale
+        a = a[..., None]
+    if a.ndim != 4:
+        return None
+    if a.shape[1] in (1, 3) and a.shape[-1] not in (1, 3):  # NCHW -> NHWC
+        a = np.transpose(a, (0, 2, 3, 1))
+    a = a.astype(np.float32)
+    if a.max() > 2.0:  # raw uint8 range
+        a = a / 255.0
+    return a
+
+
+@functools.lru_cache(maxsize=8)
+def load_edge_case_arrays(
+    data_cache_dir: Optional[str], kind: str = "southwest",
+    download: bool = False,
+) -> Optional[np.ndarray]:
+    """Real out-of-distribution images from the reference's downloaded
+    ``edge_case_examples`` archive, or None when absent (offline grace
+    — callers fall back to the synthetic far-tail mechanism and log
+    that they did). ``.pkl`` files hold numpy arrays; ``.pt`` files are
+    torch-saved datasets (torch-cpu is available for ingestion only —
+    nothing torch crosses this function's boundary).
+
+    ``download=True`` fetches the archive through the download seam
+    first (offline grace applies). Cached per (dir, kind): a
+    multi-attacker federation must not unpickle the same multi-MB array
+    once per poisoned client. Treat the returned array as read-only."""
+    if not data_cache_dir:
+        return None
+    root = os.path.join(data_cache_dir, "edge_case_examples")
+    if download and not os.path.isdir(root):
+        from .download import download_dataset
+
+        download_dataset("edge_case_examples", data_cache_dir)
+    for rel in _EDGE_CASE_FILES.get(kind, ()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        try:
+            if path.endswith(".pt"):
+                import torch
+
+                obj = torch.load(path, map_location="cpu", weights_only=False)
+                arr = getattr(obj, "data", obj)
+                if hasattr(arr, "numpy"):
+                    arr = arr.numpy()
+            else:
+                with open(path, "rb") as f:
+                    arr = pickle.load(f)
+            out = _as_nhwc(arr)
+            if out is not None and len(out):
+                return out
+        except Exception:  # noqa: BLE001 — a corrupt file must not kill FL
+            logging.exception("edge-case file %s unreadable; skipping", path)
+    return None
 
 
 def stamp_trigger(x: np.ndarray, size: int = 4, value: float = None) -> np.ndarray:
@@ -49,6 +135,8 @@ def poison_dataset(
     fraction: float = 1.0,
     trigger_size: int = 4,
     seed: int = 0,
+    data_cache_dir: Optional[str] = None,
+    edge_case_kind: str = "southwest",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Return a poisoned copy of (x, y)."""
     if poison_type not in POISON_TYPES:
@@ -68,8 +156,23 @@ def poison_dataset(
         x[chosen] = stamp_trigger(x[chosen], size=trigger_size)
         y[chosen] = target_label
     elif poison_type == "edge_case":
-        # far-tail OOD inputs claimed as the target class
-        x[chosen] = 3.0 + rng.normal(0, 0.5, x[chosen].shape).astype(x.dtype)
+        # real downloaded edge-case images when present + shape-matched
+        # (southwest 32x32x3 on cifar configs, ardis 28x28x1 on mnist),
+        # else far-tail OOD noise claimed as the target class
+        real = load_edge_case_arrays(data_cache_dir, edge_case_kind)
+        if real is not None and real.shape[1:] == x.shape[1:]:
+            x[chosen] = real[rng.randint(0, len(real), len(chosen))].astype(
+                x.dtype
+            )
+        else:
+            if data_cache_dir:
+                logging.info(
+                    "edge_case archive absent or shape-mismatched under %s; "
+                    "using synthetic far-tail noise (fetch with "
+                    "download_dataset('edge_case_examples', ...))",
+                    data_cache_dir,
+                )
+            x[chosen] = 3.0 + rng.normal(0, 0.5, x[chosen].shape).astype(x.dtype)
         y[chosen] = target_label
     return x, y
 

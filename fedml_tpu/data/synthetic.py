@@ -133,26 +133,28 @@ def synthetic_segmentation(
     feature_shape: Tuple[int, ...],
     seed: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Blob-mask segmentation stand-in (pascal_voc/cityscapes shapes):
+    """Blob-mask segmentation stand-in (pascal_voc/cityscapes shapes;
+    fets2021's 4-channel MRI-modality shape uses the same generator):
     each image gets 1-3 axis-aligned rectangles of distinct foreground
     classes on a background (class 0); pixel labels follow the
     rectangles and pixel intensities encode the class, so a small
     encoder-decoder can learn the mapping."""
     h, w = feature_shape[0], feature_shape[1]
+    ch = feature_shape[2] if len(feature_shape) > 2 else 3
     rng = np.random.RandomState(seed)
-    palette = np.random.RandomState(4321).uniform(-1, 1, (num_classes, 3)).astype(
+    palette = np.random.RandomState(4321).uniform(-1, 1, (num_classes, ch)).astype(
         np.float32
     )
-    x = np.zeros((n_samples, h, w, 3), np.float32)
+    x = np.zeros((n_samples, h, w, ch), np.float32)
     y = np.zeros((n_samples, h, w), np.int64)
     for i in range(n_samples):
-        x[i] = palette[0] + 0.3 * rng.normal(0, 1, (h, w, 3))
+        x[i] = palette[0] + 0.3 * rng.normal(0, 1, (h, w, ch))
         for _ in range(rng.randint(1, 4)):
             c = rng.randint(1, num_classes)
             hh, ww = rng.randint(h // 6, h // 2), rng.randint(w // 6, w // 2)
             r0, c0 = rng.randint(0, h - hh), rng.randint(0, w - ww)
             x[i, r0 : r0 + hh, c0 : c0 + ww] = palette[c] + 0.3 * rng.normal(
-                0, 1, (hh, ww, 3)
+                0, 1, (hh, ww, ch)
             )
             y[i, r0 : r0 + hh, c0 : c0 + ww] = c
     return x, y

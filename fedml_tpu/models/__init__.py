@@ -28,6 +28,8 @@ _IMAGE_SHAPES = {
     "cifar100": (32, 32, 3),
     "cinic10": (32, 32, 3),
     "fed_cifar100": (32, 32, 3),
+    # 4 MRI-modality channels (FeTS2021 / BraTS slices)
+    "fets2021": (64, 64, 4),
 }
 
 

@@ -499,3 +499,93 @@ class TestRegroup:
         assert sum(len(a) for a in fx) == 15
         # user 0 and 2 and 4 land on client 0
         assert set(np.unique(fy[0])) == {0, 2, 4}
+
+
+class TestEdgeCaseArrays:
+    """Real edge-case attack arrays (reference edge_case_examples
+    get_data.sh archive): .pkl numpy images and torch-saved .pt sets
+    both ingest, and the edge_case poison type uses them when the
+    archive is present (synthetic far-tail noise otherwise)."""
+
+    def _write_archive(self, cache, southwest=True, ardis=False):
+        d = cache / "edge_case_examples"
+        d.mkdir(parents=True, exist_ok=True)
+        rng = np.random.RandomState(0)
+        if southwest:
+            imgs = rng.randint(0, 256, (12, 32, 32, 3), dtype=np.uint8)
+            with open(d / "southwest_images_new_train.pkl", "wb") as f:
+                pickle.dump(imgs, f)
+        if ardis:
+            import torch
+
+            t = torch.from_numpy(
+                rng.randint(0, 256, (9, 28, 28), dtype=np.uint8)
+            )
+            torch.save(t, d / "ardis_test_dataset.pt")
+        return d
+
+    def test_pkl_and_pt_ingest(self, tmp_path):
+        from fedml_tpu.data.poison import load_edge_case_arrays
+
+        self._write_archive(tmp_path, southwest=True, ardis=True)
+        sw = load_edge_case_arrays(str(tmp_path), "southwest")
+        assert sw.shape == (12, 32, 32, 3) and sw.dtype == np.float32
+        # [0,1] — the same scale ingest.py gives real clean data, so
+        # poisoned rows do not betray themselves by value range
+        assert 0.0 <= float(sw.min()) and float(sw.max()) <= 1.0
+        ar = load_edge_case_arrays(str(tmp_path), "ardis")
+        assert ar.shape == (9, 28, 28, 1)
+        assert load_edge_case_arrays(str(tmp_path), "howto") is None
+        assert load_edge_case_arrays(None, "southwest") is None
+
+    def test_edge_case_poison_uses_real_arrays(self, tmp_path):
+        from fedml_tpu.data.poison import load_edge_case_arrays, poison_dataset
+
+        self._write_archive(tmp_path, southwest=True)
+        real = load_edge_case_arrays(str(tmp_path), "southwest")
+        x = np.zeros((20, 32, 32, 3), np.float32)
+        y = np.arange(20) % 10
+        px, py = poison_dataset(
+            x, y, "edge_case", num_classes=10, target_label=3,
+            fraction=0.5, data_cache_dir=str(tmp_path),
+        )
+        changed = np.where((px != x).any(axis=(1, 2, 3)))[0]
+        assert len(changed) == 10
+        assert (py[changed] == 3).all()
+        # every poisoned row is one of the REAL images, not noise
+        flat_real = real.reshape(len(real), -1)
+        for i in changed:
+            assert (
+                np.abs(flat_real - px[i].reshape(1, -1)).max(axis=1).min() < 1e-6
+            )
+        # shape mismatch (mnist-shaped x vs 32x32 southwest) falls back
+        xm = np.zeros((8, 28, 28, 1), np.float32)
+        pm, _ = poison_dataset(
+            xm, np.zeros(8, np.int64), "edge_case", num_classes=10,
+            data_cache_dir=str(tmp_path), fraction=1.0,
+        )
+        assert float(pm.mean()) > 1.0  # far-tail noise branch
+
+
+class TestFets2021:
+    def test_standin_loads_and_trains(self, args_factory):
+        """FeTS2021 (data/FeTS2021/download.sh): 4-channel MRI-modality
+        segmentation federation; the stand-in exercises the full
+        pipeline shape (real extracted copies override via
+        data_cache_dir like every other dataset)."""
+        args = _args(
+            args_factory,
+            dataset="fets2021",
+            model="deeplab",
+            synthetic_train_size=64,
+            synthetic_test_size=16,
+            batch_size=8,
+            comm_round=1,
+        )
+        ds = load(args)
+        assert ds.task == "segmentation" and ds.class_num == 4
+        assert ds.packed_train.x.shape[-1] == 4  # modality channels
+        model = models.create(args, ds.class_num)
+        api = FedAvgAPI(args, None, ds, model)
+        stats = api.train()
+        assert np.isfinite(stats["test_acc"])

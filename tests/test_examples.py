@@ -105,6 +105,14 @@ class TestSimulationExamples:
         )
         assert "FINAL:" in r.stdout
 
+    def test_distributed_step_by_step_8_devices(self):
+        d = os.path.join(EXAMPLES, "distributed", "step_by_step")
+        r = _run(
+            [sys.executable, "main.py", "--cf", "fedml_config.yaml"],
+            cwd=d, env=_env(devices=8), timeout=580,
+        )
+        assert "FINAL:" in r.stdout
+
     def test_longcontext_one_line_8_devices(self):
         d = os.path.join(EXAMPLES, "longcontext", "one_line")
         r = _run(
@@ -115,10 +123,13 @@ class TestSimulationExamples:
 
 
 class TestCrossSiloExample:
-    def test_server_two_clients_grpc(self, tmp_path):
+    @pytest.mark.parametrize("tier", ["one_line", "step_by_step"])
+    def test_server_two_clients_grpc(self, tmp_path, tier):
+        """Both tiers run identically — step_by_step IS one_line's five
+        stages (init/device/data/model/runner), spelled out."""
         base = _free_port_block(4)
         d = _patched_config(
-            os.path.join(EXAMPLES, "cross_silo", "one_line"), tmp_path, base
+            os.path.join(EXAMPLES, "cross_silo", tier), tmp_path, base
         )
         env = _env()
         clients = [
