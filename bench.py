@@ -142,7 +142,10 @@ def _force_cpu(n_devices: int = 1) -> None:
     _force_virtual_cpu(n_devices)
 
 
-def _build_api(n_clients: int, epochs: int, per_client: int = 600, **extra):
+def _build_api(
+    n_clients: int, epochs: int, per_client: int = 600, mesh: bool = False,
+    **extra,
+):
     import fedml_tpu
     from fedml_tpu import models
     from fedml_tpu.arguments import Arguments
@@ -173,6 +176,15 @@ def _build_api(n_clients: int, epochs: int, per_client: int = 600, **extra):
     args = fedml_tpu.init(args)
     dataset = load(args)
     model = models.create(args, dataset.class_num)
+    if mesh:
+        # client axis over every visible device (parallel/mesh.py
+        # default); SimulatorMesh shards the packed federation and
+        # replicates params — its fl_trainer is the same FedAvgAPI,
+        # so _time_rounds works unchanged on the sharded arrays
+        from fedml_tpu.simulation.simulator import SimulatorMesh
+
+        sim = SimulatorMesh(args, None, dataset, model)
+        return args, dataset, model, sim.fl_trainer
     api = FedAvgAPI(args, None, dataset, model)
     return args, dataset, model, api
 
@@ -536,6 +548,45 @@ def run_longctx(on_cpu: bool, out_path: str | None = None) -> dict:
     return out
 
 
+def run_mesh(on_cpu: bool) -> dict:
+    """Mesh-simulator phase (VERDICT r4 next #8): the headline cohort
+    run through SimulatorMesh with the client axis over every visible
+    device. On the 1-chip TPU this measures the mesh path's overhead vs
+    the plain-vmap engine — the single-chip-measured baseline the
+    multi-chip scaling story extrapolates from (the parent stitches
+    ``vs_vmap_engine`` against the headline). On the CPU fallback a
+    2-device virtual mesh exercises real sharding (more devices drown
+    the 1-core box in collective emulation) and the output is stamped
+    ``cpu_fallback``."""
+    import jax
+
+    if on_cpu:
+        # emulating a device mesh on ONE physical core is ~90s/round at
+        # headline size (8 virtual devices of collective emulation +
+        # thread oversubscription) — exercise the phase with a 2-device
+        # mesh and a mini cohort
+        cohort = dict(n_clients=4, epochs=1, n_rounds=1, per_client=50)
+    else:
+        cohort = _headline_cohort(on_cpu)
+    args, dataset, _model, api = _build_api(
+        cohort["n_clients"], cohort["epochs"],
+        per_client=cohort["per_client"], mesh=True,
+    )
+    _progress("mesh built")
+    rps, spr, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    _progress(f"mesh timed: {rps:.3f} rounds/s")
+    out = {
+        "mesh_shape": {"clients": len(jax.devices())},
+        "rounds_per_sec": round(rps, 4),
+        "samples_per_sec": round(rps * spr, 1),
+    }
+    if on_cpu:
+        # a manually captured --cpu mesh JSON must never read as a TPU
+        # number in cross-round diffs (same rule as _demote_fallback)
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -629,6 +680,7 @@ _HEADLINE_TIMEOUT_S = 270.0
 _DENSE_TIMEOUT_S = 170.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
+_MESH_TIMEOUT_S = 90.0
 _SWEEP_TIMEOUT_S = 90.0
 # 512 became feasible when stand-in cohorts moved on-device (the
 # cohort is a compute knob now, not a transfer one; 1024 would push
@@ -899,52 +951,58 @@ def _main_guarded() -> None:
             # no silent caps: record what was dropped and why
             result["detail"]["scaling_skipped"] = skipped
 
-        # mixed-precision point (own child): bf16 vs the f32 headline
-        if _BUDGET_S - _elapsed() <= 100:
-            result["detail"]["bf16_skipped"] = "budget exhausted"
-        elif not _tunnel_usable():
-            result["detail"]["bf16_skipped"] = "tunnel wedged"
-        else:
+        def _stitch_phase(key, timeout_s, gate_s, stitch=None):
+            """budget-gate -> tunnel-check -> isolated child -> stitch
+            or record the skip (shared by bf16/longctx/mesh; dense
+            differs — it runs demoted on the CPU fallback). remaining
+            is recomputed AFTER _tunnel_usable because the wedge probe
+            spends up to _WEDGE_PROBE_TIMEOUT_S."""
+            detail = result["detail"]
+            if _BUDGET_S - _elapsed() <= gate_s:
+                detail[f"{key}_skipped"] = "budget exhausted"
+                return
+            if not _tunnel_usable():
+                detail[f"{key}_skipped"] = "tunnel wedged"
+                return
             remaining = _BUDGET_S - _elapsed()
-            bf16, bnote = (
+            out, note = (
                 (None, "budget exhausted after probe")
                 if remaining < 40
                 else _run_phase_subprocess(
-                    ["--phase", "bf16"], min(_BF16_TIMEOUT_S, remaining - 10)
+                    ["--phase", key], min(timeout_s, remaining - 10)
                 )
             )
-            if bf16 is not None:
-                bf16["speedup_vs_f32"] = round(
-                    bf16["rounds_per_sec"] / max(result["value"], 1e-9), 2
-                )
-                result["detail"]["bf16"] = bf16
+            if out is not None:
+                if stitch:
+                    stitch(out)
+                detail[key] = out
             else:
-                _note_phase_outcome(bnote)
-                result["detail"]["bf16_skipped"] = bnote
-                _progress(f"bf16 phase skipped ({bnote})")
+                _note_phase_outcome(note)
+                detail[f"{key}_skipped"] = note
+                _progress(f"{key} phase skipped ({note})")
 
-        # long-context kernel point (own child): pallas flash attention
-        # vs naive XLA attention at T=4096 — the long-context perf story
-        if _BUDGET_S - _elapsed() <= 70:
-            result["detail"]["longctx_skipped"] = "budget exhausted"
-        elif not _tunnel_usable():
-            result["detail"]["longctx_skipped"] = "tunnel wedged"
-        else:
-            remaining = _BUDGET_S - _elapsed()
-            lc, lcnote = (
-                (None, "budget exhausted after probe")
-                if remaining < 40
-                else _run_phase_subprocess(
-                    ["--phase", "longctx"],
-                    min(_LONGCTX_TIMEOUT_S, remaining - 10),
-                )
-            )
-            if lc is not None:
-                result["detail"]["longctx"] = lc
-            else:
-                _note_phase_outcome(lcnote)
-                result["detail"]["longctx_skipped"] = lcnote
-                _progress(f"longctx phase skipped ({lcnote})")
+        # mixed-precision point: bf16 vs the f32 headline
+        _stitch_phase(
+            "bf16", _BF16_TIMEOUT_S, gate_s=100,
+            stitch=lambda o: o.__setitem__(
+                "speedup_vs_f32",
+                round(o["rounds_per_sec"] / max(result["value"], 1e-9), 2),
+            ),
+        )
+        # long-context kernel point: pallas flash attention vs naive
+        # XLA attention at T=4096 — the long-context perf story
+        _stitch_phase("longctx", _LONGCTX_TIMEOUT_S, gate_s=70)
+        # mesh-simulator point: the headline cohort through
+        # SimulatorMesh — the single-chip mesh baseline the multi-chip
+        # scaling story extrapolates from (VERDICT r4 next #8; stays
+        # last so budget pressure sheds it first)
+        _stitch_phase(
+            "mesh", _MESH_TIMEOUT_S, gate_s=60,
+            stitch=lambda o: o.__setitem__(
+                "vs_vmap_engine",
+                round(o["rounds_per_sec"] / max(result["value"], 1e-9), 3),
+            ),
+        )
 
     _attach_capture_sidecar(result)
     _emit(result)
@@ -957,14 +1015,17 @@ def _phase_main(argv) -> None:
     p = argparse.ArgumentParser()
     p.add_argument(
         "--phase", required=True,
-        choices=["headline", "bf16", "dense", "sweep", "longctx"],
+        choices=["headline", "bf16", "dense", "sweep", "longctx", "mesh"],
     )
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", required=True)
     a = p.parse_args(argv)
     if a.cpu:
-        _force_cpu()
+        # the mesh phase needs devices to shard over — 2 virtual CPU
+        # devices (more drowns the 1-core box in collective emulation);
+        # other phases run 1
+        _force_cpu(2 if a.phase == "mesh" else 1)
     if a.phase == "headline":
         out = run_headline(on_cpu=a.cpu)
     elif a.phase == "bf16":
@@ -973,6 +1034,8 @@ def _phase_main(argv) -> None:
         out = run_dense(on_cpu=a.cpu)
     elif a.phase == "longctx":
         out = run_longctx(on_cpu=a.cpu, out_path=a.out)
+    elif a.phase == "mesh":
+        out = run_mesh(on_cpu=a.cpu)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -61,6 +61,7 @@ PHASES = [
     ("sweep_128", ["--phase", "sweep", "--cohort", "128"], 240.0),
     ("sweep_256", ["--phase", "sweep", "--cohort", "256"], 300.0),
     ("sweep_512", ["--phase", "sweep", "--cohort", "512"], 360.0),
+    ("mesh", ["--phase", "mesh"], 240.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 
