@@ -1,0 +1,46 @@
+"""Custom-operator cross-silo client (reference example tier:
+...grpc_fedavg_mnist_lr_example/custom/ — user subclasses the L3
+operator frame, core/alg_frame/client_trainer.py:4-40, and hands it to
+the runner).
+
+The SAME ``ClippedDeltaTrainer`` pattern as
+``examples/simulation_sp/custom`` — the L3 seam (core/frame.py) is a
+pure train-fn factory, so one subclass runs unchanged under the SP
+simulator, the mesh simulator, and (here) a real gRPC cross-silo
+client process.
+
+Run:  python client.py --cf fedml_config.yaml --rank <1..N>
+"""
+
+import jax
+import jax.numpy as jnp
+
+import fedml_tpu
+from fedml_tpu import DefaultClientTrainer
+
+
+class ClippedDeltaTrainer(DefaultClientTrainer):
+    """Local training with a client-side update-norm cap."""
+
+    MAX_NORM = 1.0
+
+    def make_train_fn(self, args):
+        inner = super().make_train_fn(args)
+
+        def train(params, batches, rng):
+            new, metrics = inner(params, batches, rng)
+            delta = jax.tree.map(lambda n, p: n - p, new, params)
+            norm = jnp.sqrt(
+                sum(jnp.vdot(d, d) for d in jax.tree.leaves(delta))
+            )
+            scale = jnp.minimum(1.0, self.MAX_NORM / jnp.maximum(norm, 1e-12))
+            clipped = jax.tree.map(lambda p, d: p + scale * d, params, delta)
+            return clipped, metrics
+
+        return train
+
+
+if __name__ == "__main__":
+    fedml_tpu.run_cross_silo_client(
+        client_trainer=ClippedDeltaTrainer(model=None)
+    )

@@ -53,7 +53,10 @@ LOG_PATH = os.path.join(_REPO, "tpu_watch.log")
 # and sized for first-compile-on-TPU (ResNet cohort: minutes).
 PHASES = [
     ("dense", ["--phase", "dense"], 600.0),
-    ("longctx", ["--phase", "longctx"], 420.0),
+    # longctx runs flash+naive plus 3 block-size tuning variants (each
+    # a fresh pallas compile + 10 fwd+bwd iters at B4/H8/T4096) — size
+    # the window for all 5, not just the headline pair
+    ("longctx", ["--phase", "longctx"], 720.0),
     ("bf16", ["--phase", "bf16"], 300.0),
     ("headline", ["--phase", "headline"], 420.0),
     ("sweep_8", ["--phase", "sweep", "--cohort", "8"], 180.0),
@@ -99,10 +102,34 @@ def _load_capture() -> dict:
 
 
 def _save_capture(cap: dict) -> None:
-    fd, tmp = tempfile.mkstemp(dir=_REPO, suffix=".tmp")
+    # tmp lives next to the destination: same-directory rename is the
+    # atomic one (cross-device os.replace raises EXDEV)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(CAPTURE_PATH) or ".", suffix=".tmp"
+    )
     with os.fdopen(fd, "w") as fh:
         json.dump(cap, fh, indent=2)
     os.replace(tmp, CAPTURE_PATH)
+
+
+def _pending(cap: dict) -> list:
+    """Phases still worth attempting: not captured (a PARTIAL capture —
+    the child died after flushing some variants — counts as pending so
+    a later window completes it; the partial is kept and only replaced
+    by a fuller result), attempts left."""
+
+    def _is_partial(name: str) -> bool:
+        entry = cap["phases"].get(name)
+        return isinstance(entry, dict) and "partial_note" in (
+            entry.get("result") or {}
+        )
+
+    return [
+        (n, a, t)
+        for n, a, t in PHASES
+        if (n not in cap["phases"] or _is_partial(n))
+        and cap["attempts"].get(n, 0) < MAX_ATTEMPTS
+    ]
 
 
 def _probe(timeout_s: float) -> bool:
@@ -168,12 +195,7 @@ def main() -> None:
         if os.path.exists(STOP_FILE):
             _log("stop file found — exiting")
             return
-        pending = [
-            (n, a, t)
-            for n, a, t in PHASES
-            if n not in cap["phases"]
-            and cap["attempts"].get(n, 0) < MAX_ATTEMPTS
-        ]
+        pending = _pending(cap)
         if not pending:
             _log("all phases captured (or out of attempts) — exiting")
             return
@@ -196,6 +218,12 @@ def main() -> None:
             _log(f"phase {name} (attempt {cap['attempts'][name]}) ...")
             result, note = _run_phase(name, phase_args, timeout_s)
             dt = time.time() - t0
+            prev = (cap["phases"].get(name) or {}).get("result") or {}
+            if result is not None and len(result) < len(prev):
+                # a retry that flushed fewer variants than an existing
+                # partial must not clobber the richer capture
+                _log(f"phase {name}: retry thinner than existing capture; kept old")
+                result = None
             if result is not None:
                 cap["phases"][name] = {
                     "captured_at": _utcnow(),

@@ -123,10 +123,11 @@ class TestSimulationExamples:
 
 
 class TestCrossSiloExample:
-    @pytest.mark.parametrize("tier", ["one_line", "step_by_step"])
+    @pytest.mark.parametrize("tier", ["one_line", "step_by_step", "custom"])
     def test_server_two_clients_grpc(self, tmp_path, tier):
-        """Both tiers run identically — step_by_step IS one_line's five
-        stages (init/device/data/model/runner), spelled out."""
+        """All tiers run identically — step_by_step IS one_line's five
+        stages (init/device/data/model/runner) spelled out; custom
+        plugs L3 operator subclasses into the same runners."""
         base = _free_port_block(4)
         d = _patched_config(
             os.path.join(EXAMPLES, "cross_silo", tier), tmp_path, base
