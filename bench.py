@@ -80,6 +80,16 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = ("headline", "bf16", "dense", "sweep", "longctx", "mesh")
 
+
+def _capture_dir() -> str:
+    """Where the tunnel-watcher's capture sidecar lives (test seam)."""
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+# Stand-down handshake file shared with scripts/tpu_watch.py (pinned by
+# a drift test like _CAPTURE_BASENAME / PHASE_CHOICES).
+_STOP_BASENAME = ".tpu_watch_stop"
+
 # bf16 peak matmul TFLOP/s by device kind (public spec sheets); used
 # only to contextualize achieved FLOP/s as a rough MFU. Unknown kinds
 # report achieved FLOP/s without an MFU.
@@ -477,10 +487,25 @@ def run_dense(on_cpu: bool) -> dict:
     }
     if flops:
         out.update(_mfu_detail(flops, rps))
+    try:
+        # HBM headroom tells the optimization story where to go next:
+        # plenty free -> grow batch/cohort toward MXU saturation;
+        # near the ceiling -> remat / smaller per-round state
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            out["hbm_used_gb"] = round(stats["bytes_in_use"] / 1e9, 2)
+        if "bytes_limit" in stats:
+            out["hbm_limit_gb"] = round(stats["bytes_limit"] / 1e9, 2)
+    except Exception:  # noqa: BLE001 — telemetry only, never fail the phase
+        pass
     return out
 
 
-def run_longctx(on_cpu: bool, out_path: str | None = None) -> dict:
+def run_longctx(
+    on_cpu: bool, out_path: str | None = None, tune: bool = False
+) -> dict:
     """Long-context kernel phase: the pallas flash-attention kernel
     (ops/flash_attention.py — blockwise online-softmax, custom_vjp
     blockwise backward) vs naive XLA attention (materializes the [T, T]
@@ -537,13 +562,14 @@ def run_longctx(on_cpu: bool, out_path: str | None = None) -> dict:
 
     flash = functools.partial(flash_attention, causal=True)
     out = {"shape": f"B{B} H{H} T{T} D{D}", "dtype": str(dtype.__name__)}
-    # a tunnel window is rare — make one capture carry the block-size
-    # tuning data too (VERDICT r4 next #4: if flash loses to naive,
-    # tune via block sizes / VMEM budget). Variants are flushed
-    # incrementally like the main timings; skipped on CPU (interpreter
-    # mode timings would mislead the tuning).
+    # --tune (the watcher's 720s window passes it): a tunnel window is
+    # rare, so one capture also carries block-size tuning data
+    # (VERDICT r4 next #4: if flash loses to naive, tune via block
+    # sizes / VMEM budget). OFF for the round-end driver child (its
+    # 110s window fits flash+naive only) and on CPU (interpreter-mode
+    # timings would mislead the tuning). Variants flush incrementally.
     variants = [("flash", flash), ("naive", naive)]
-    if not on_cpu:
+    if tune and not on_cpu:
         for bq, bk in ((256, 256), (128, 512), (512, 128)):
             variants.append(
                 (
@@ -750,10 +776,9 @@ def _attach_capture_sidecar(result: dict) -> None:
     capture sidecar is where the round's real TPU numbers live — embed
     them (clearly labeled, each entry carries its own UTC capture time)
     so BENCH_r05.json is self-contained for the judge."""
-    here = os.path.dirname(os.path.abspath(__file__))
     # pinned to THIS round's capture file (not a glob): an older round's
     # capture must never be relabeled as this round's TPU numbers
-    path = os.path.join(here, _CAPTURE_BASENAME)
+    path = os.path.join(_capture_dir(), _CAPTURE_BASENAME)
     if not os.path.exists(path):
         return
     try:
@@ -829,6 +854,21 @@ def _demote_fallback(result: dict, note: str) -> None:
 
 
 def _main_guarded() -> None:
+    # a full bench run owns the box (1 core here): signal the tunnel
+    # watcher to stand down so its probe/phase children cannot contend
+    # with the driver's round-end certification windows
+    try:
+        stop = os.path.join(_capture_dir(), _STOP_BASENAME)
+        if not os.path.exists(stop):
+            with open(stop, "w") as fh:
+                fh.write("round-end bench running\n")
+            _progress("tunnel watcher stop-file written")
+        # the watcher kills its in-flight phase child within ~5s of the
+        # stop-file appearing and drops a goodbye marker in its log; a
+        # short grace keeps its teardown off this run's first window
+        time.sleep(6)
+    except OSError:
+        pass
     _progress("probing TPU")
     tpu_ok, note = _probe_tpu()
     _progress(f"probe: ok={tpu_ok} ({note})")
@@ -1059,6 +1099,7 @@ def _phase_main(argv) -> None:
     p.add_argument("--phase", required=True, choices=list(PHASE_CHOICES))
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--tune", action="store_true")
     p.add_argument("--out", required=True)
     a = p.parse_args(argv)
     if a.cpu:
@@ -1073,7 +1114,7 @@ def _phase_main(argv) -> None:
     elif a.phase == "dense":
         out = run_dense(on_cpu=a.cpu)
     elif a.phase == "longctx":
-        out = run_longctx(on_cpu=a.cpu, out_path=a.out)
+        out = run_longctx(on_cpu=a.cpu, out_path=a.out, tune=a.tune)
     elif a.phase == "mesh":
         out = run_mesh(on_cpu=a.cpu)
     else:

@@ -42,7 +42,7 @@ sys.path.insert(0, _REPO)
 import bench  # noqa: E402  — reuses _child_env (compile cache) + probe code
 
 CAPTURE_PATH = os.path.join(_REPO, bench._CAPTURE_BASENAME)
-STOP_FILE = os.path.join(_REPO, ".tpu_watch_stop")
+STOP_FILE = os.path.join(_REPO, bench._STOP_BASENAME)
 LOG_PATH = os.path.join(_REPO, "tpu_watch.log")
 
 # Priority order = information value per VERDICT r4 "Next round" #1:
@@ -53,10 +53,11 @@ LOG_PATH = os.path.join(_REPO, "tpu_watch.log")
 # and sized for first-compile-on-TPU (ResNet cohort: minutes).
 PHASES = [
     ("dense", ["--phase", "dense"], 600.0),
-    # longctx runs flash+naive plus 3 block-size tuning variants (each
-    # a fresh pallas compile + 10 fwd+bwd iters at B4/H8/T4096) — size
-    # the window for all 5, not just the headline pair
-    ("longctx", ["--phase", "longctx"], 720.0),
+    # --tune: flash+naive plus 3 block-size tuning variants (each a
+    # fresh pallas compile + 10 fwd+bwd iters at B4/H8/T4096) — the
+    # watcher's window is sized for all 5; the round-end driver child
+    # runs without --tune in its 110s window
+    ("longctx", ["--phase", "longctx", "--tune"], 720.0),
     ("bf16", ["--phase", "bf16"], 300.0),
     ("headline", ["--phase", "headline"], 420.0),
     ("sweep_8", ["--phase", "sweep", "--cohort", "8"], 180.0),
@@ -141,7 +142,10 @@ def _probe(timeout_s: float) -> bool:
 
 def _run_phase(name: str, phase_args: list, timeout_s: float):
     """(result|None, note) — mirrors bench._run_phase_subprocess but
-    keeps partial child output (longctx flushes per-variant)."""
+    keeps partial child output (longctx flushes per-variant) and kills
+    the child within ~5s of the stop-file appearing (a round-end
+    bench.py writes it to take the 1-core box; a fire-and-forget
+    handshake would leave this child contending for minutes)."""
     with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
         out_path = f.name
     cmd = [sys.executable, os.path.join(_REPO, "bench.py")] + phase_args + [
@@ -149,17 +153,31 @@ def _run_phase(name: str, phase_args: list, timeout_s: float):
     ]
     note = "ok"
     try:
-        r = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s,
-            env=bench._child_env(), cwd=_REPO,
-        )
-        for line in (r.stderr or "").splitlines()[-8:]:
+        with tempfile.TemporaryFile("w+") as errf:
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.DEVNULL, stderr=errf,
+                text=True, env=bench._child_env(), cwd=_REPO,
+            )
+            deadline = time.time() + timeout_s
+            while proc.poll() is None:
+                if time.time() > deadline:
+                    proc.kill()
+                    proc.wait()
+                    note = f"timeout after {timeout_s:.0f}s"
+                    break
+                if os.path.exists(STOP_FILE):
+                    proc.kill()
+                    proc.wait()
+                    note = "killed by stop-file (box handed over)"
+                    break
+                time.sleep(5)
+            errf.seek(0)
+            stderr = errf.read()
+        for line in stderr.splitlines()[-8:]:
             _log(f"  child: {line}")
-        if r.returncode != 0:
-            tail = (r.stderr or r.stdout or "").strip().splitlines()[-1:]
-            note = f"rc={r.returncode}: {tail[0] if tail else ''}"
-    except subprocess.TimeoutExpired:
-        note = f"timeout after {timeout_s:.0f}s"
+        if note == "ok" and proc.returncode != 0:
+            tail = stderr.strip().splitlines()[-1:]
+            note = f"rc={proc.returncode}: {tail[0] if tail else ''}"
     except Exception as e:  # noqa: BLE001
         note = f"{type(e).__name__}: {e}"
     try:
@@ -185,6 +203,13 @@ def main() -> None:
     args = p.parse_args()
     deadline = time.time() + args.hours * 3600
 
+    if os.path.exists(STOP_FILE):
+        # a stale stand-down marker (e.g. from an earlier bench run)
+        # must not veto an explicit new watch — launching the watcher
+        # IS the operator's intent
+        os.unlink(STOP_FILE)
+        _log("stale stop-file cleared at startup")
+
     cap = _load_capture()
     _log(
         f"start: deadline in {args.hours}h, "
@@ -201,7 +226,12 @@ def main() -> None:
             return
 
         if not _probe(args.probe_timeout):
-            time.sleep(args.interval)
+            # chunked sleep so a stop-file (written e.g. by a round-end
+            # bench.py taking the box) is honored within ~15s, not
+            # after a full interval
+            end = time.time() + args.interval
+            while time.time() < end and not os.path.exists(STOP_FILE):
+                time.sleep(min(15, max(0.1, end - time.time())))
             continue
 
         _log(f"tunnel UP — pending: {[n for n, _, _ in pending]}")
@@ -219,11 +249,16 @@ def main() -> None:
             result, note = _run_phase(name, phase_args, timeout_s)
             dt = time.time() - t0
             prev = (cap["phases"].get(name) or {}).get("result") or {}
-            if result is not None and len(result) < len(prev):
-                # a retry that flushed fewer variants than an existing
-                # partial must not clobber the richer capture
-                _log(f"phase {name}: retry thinner than existing capture; kept old")
+            if (
+                result is not None
+                and "partial_note" in result
+                and len(result) < len(prev)
+            ):
+                # a retry that died EARLIER than an existing partial
+                # must not clobber the richer capture (a complete rc=0
+                # retry always wins, whatever its key count)
                 result = None
+                note = "thinner partial than existing capture; kept old"
             if result is not None:
                 cap["phases"][name] = {
                     "captured_at": _utcnow(),

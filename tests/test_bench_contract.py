@@ -70,3 +70,69 @@ class TestPhaseChild:
                 assert k in d
         finally:
             os.unlink(out)
+
+
+class TestCaptureSidecar:
+    """_attach_capture_sidecar folds the tunnel-watcher's capture into
+    the round-end JSON exactly when TPU numbers are missing from the
+    live run — never otherwise, and never from another round's file."""
+
+    def _with_capture(self, monkeypatch, tmp_path, phases):
+        path = tmp_path / bench._CAPTURE_BASENAME
+        path.write_text(json.dumps({"phases": phases}))
+        monkeypatch.setattr(bench, "_capture_dir", lambda: str(tmp_path))
+        return path
+
+    def test_attaches_on_cpu_fallback_and_promotes_headline(
+        self, monkeypatch, tmp_path
+    ):
+        self._with_capture(
+            monkeypatch, tmp_path,
+            {
+                "headline": {
+                    "captured_at": "T",
+                    "result": {"value": 1.2, "vs_baseline": 30.0, "unit": "u"},
+                },
+            },
+        )
+        r = {"metric": "m", "value": 0.05, "vs_baseline": 0.7, "unit": "u",
+             "cpu_fallback": True, "detail": {}}
+        bench._attach_capture_sidecar(r)
+        sc = r["detail"]["tpu_capture_sidecar"]
+        assert sc["source"] == bench._CAPTURE_BASENAME
+        assert r["tpu_capture_headline"]["value"] == 1.2
+
+    def test_attaches_on_phase_error_or_partial(self, monkeypatch, tmp_path):
+        self._with_capture(
+            monkeypatch, tmp_path, {"dense": {"result": {"x": 1}}}
+        )
+        for detail in (
+            {"longctx": {"flash_ms": 2.0, "naive_error": "OOM"}},
+            {"longctx": {"flash_ms": 2.0, "partial_note": "timeout after 110s"}},
+            {"dense_skipped": "tunnel wedged"},
+        ):
+            r = {"metric": "m", "value": 1.0, "vs_baseline": 30.0, "unit": "u",
+                 "detail": dict(detail)}
+            bench._attach_capture_sidecar(r)
+            assert "tpu_capture_sidecar" in r["detail"], detail
+
+    def test_no_attach_when_live_run_complete(self, monkeypatch, tmp_path):
+        self._with_capture(
+            monkeypatch, tmp_path, {"dense": {"result": {"x": 1}}}
+        )
+        r = {"metric": "m", "value": 1.0, "vs_baseline": 30.0, "unit": "u",
+             "detail": {"dense": {"rounds_per_sec": 2.0}}}
+        bench._attach_capture_sidecar(r)
+        assert "tpu_capture_sidecar" not in r["detail"]
+
+    def test_no_attach_from_other_rounds_capture(self, monkeypatch, tmp_path):
+        # an r04 file must never masquerade as this round's numbers
+        (tmp_path / "BENCH_TPU_CAPTURE_r04.json").write_text(
+            json.dumps({"phases": {"headline": {"result": {"value": 9.9}}}})
+        )
+        monkeypatch.setattr(bench, "_capture_dir", lambda: str(tmp_path))
+        r = {"metric": "m", "value": 0, "vs_baseline": 0, "unit": "u",
+             "error": "all failed", "detail": {}}
+        bench._attach_capture_sidecar(r)
+        assert "tpu_capture_sidecar" not in r["detail"]
+        assert "tpu_capture_headline" not in r

@@ -56,6 +56,13 @@ class TestCapturePersistence:
 
         assert os.path.basename(watch.CAPTURE_PATH) == bench._CAPTURE_BASENAME
 
+    def test_stop_file_pinned_to_bench_constant(self, watch):
+        """bench's round-end stand-down marker and the watcher's stop
+        check must name the same file or the handshake silently dies."""
+        import bench
+
+        assert os.path.basename(watch.STOP_FILE) == bench._STOP_BASENAME
+
 
 class TestPendingSelection:
     def test_priority_order_and_filtering(self, watch):
@@ -101,18 +108,32 @@ class TestPendingSelection:
 
 
 class TestStopFile:
-    def test_stop_file_exits_before_probing(self, watch, monkeypatch, tmp_path):
+    def test_stale_stop_cleared_then_midrun_stop_honored(
+        self, watch, monkeypatch, tmp_path
+    ):
+        """A stale stand-down marker (e.g. left by an earlier bench
+        run) must not veto an explicit new watch — launching the
+        watcher IS the operator's intent — but a stop file appearing
+        MID-RUN (a round-end bench taking the box) exits promptly."""
         stop = str(tmp_path / "stop")
-        open(stop, "w").close()
+        open(stop, "w").close()  # stale, pre-startup
         monkeypatch.setattr(watch, "STOP_FILE", stop)
         monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
         monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
+        probes = []
 
-        def _no_probe(*a, **k):  # the whole point: never reached
-            raise AssertionError("probed despite stop file")
+        def fake_probe(*a, **k):
+            # the stale file was cleared, so we got here; now simulate
+            # a round-end bench writing a FRESH stop file mid-run
+            assert not os.path.exists(stop), "stale stop not cleared"
+            probes.append(1)
+            open(stop, "w").close()
+            return False
 
-        monkeypatch.setattr(watch, "_probe", _no_probe)
+        monkeypatch.setattr(watch, "_probe", fake_probe)
         monkeypatch.setattr(
-            sys, "argv", ["tpu_watch.py", "--hours", "0.01"]
+            sys, "argv",
+            ["tpu_watch.py", "--hours", "0.05", "--interval", "1"],
         )
-        watch.main()  # returns immediately; _probe would raise
+        watch.main()  # exits via the mid-run stop file, not the deadline
+        assert probes == [1]
