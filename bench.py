@@ -205,7 +205,8 @@ def _build_api(
 
 
 def _time_rounds(api, dataset, args, n_rounds: int):
-    """(rounds/s, samples/round, flops/round-or-None) for one cohort."""
+    """(rounds/s, samples/round, flops/round-or-None, xla-mem-or-None)
+    for one cohort."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -230,6 +231,19 @@ def _time_rounds(api, dataset, args, n_rounds: int):
         flops = float(ca.get("flops", 0.0)) or None
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         flops = None
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        # XLA's own buffer plan: where a low MFU should send the
+        # optimizer next (temp-dominated -> remat/layout; argument-
+        # dominated -> batch geometry has headroom)
+        mem = {
+            "xla_temp_mb": round(ma.temp_size_in_bytes / 1e6, 1),
+            "xla_argument_mb": round(ma.argument_size_in_bytes / 1e6, 1),
+            "xla_output_mb": round(ma.output_size_in_bytes / 1e6, 1),
+        }
+    except Exception:  # noqa: BLE001 — best-effort, backend-dependent
+        mem = None
 
     params, state, _ = compiled(
         params, state, packed, nsamples, idx, jax.random.fold_in(rng, 0)
@@ -243,7 +257,7 @@ def _time_rounds(api, dataset, args, n_rounds: int):
     jax.block_until_ready(jax.tree.leaves(params)[0])
     rps = n_rounds / (time.perf_counter() - t0)
     samples_per_round = float(np.sum(dataset.packed_num_samples)) * int(args.epochs)
-    return rps, samples_per_round, flops
+    return rps, samples_per_round, flops, mem
 
 
 def _sequential_baseline(api, dataset, args, n_seq: int):
@@ -377,7 +391,7 @@ def run_headline(on_cpu: bool) -> dict:
         n_clients, epochs, per_client=headline_per_client
     )
     _progress("headline built")
-    vec_rps, samples_per_round, flops = _time_rounds(api, dataset, args, n_rounds)
+    vec_rps, samples_per_round, flops, _ = _time_rounds(api, dataset, args, n_rounds)
     _progress(f"headline timed: {vec_rps:.3f} rounds/s")
     seq_rps = _sequential_baseline(api, dataset, args, n_seq)
     _progress(f"sequential baseline: {seq_rps:.4f} rounds/s")
@@ -437,7 +451,7 @@ def run_bf16(on_cpu: bool) -> dict:
         per_client=cohort["per_client"], dtype="bfloat16",
     )
     _progress("bf16 built")
-    rps, spr, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    rps, spr, _, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
     _progress(f"bf16 timed: {rps:.3f} rounds/s")
     return {
         "rounds_per_sec": round(rps, 4),
@@ -473,7 +487,7 @@ def run_dense(on_cpu: bool) -> dict:
         dtype="bfloat16",
     )
     _progress(f"dense ({model_name}/cifar10) built")
-    rps, spr, flops = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    rps, spr, flops, mem = _time_rounds(api, dataset, args, cohort["n_rounds"])
     _progress(f"dense timed: {rps:.3f} rounds/s")
     out = {
         "model": "resnet18_gn" if not on_cpu else "cnn (cpu fallback stand-in)",
@@ -487,6 +501,8 @@ def run_dense(on_cpu: bool) -> dict:
     }
     if flops:
         out.update(_mfu_detail(flops, rps))
+    if mem:
+        out["xla_memory_analysis"] = mem
     try:
         # HBM headroom tells the optimization story where to go next:
         # plenty free -> grow batch/cohort toward MXU saturation;
@@ -642,7 +658,7 @@ def run_mesh(on_cpu: bool) -> dict:
         per_client=cohort["per_client"], mesh=True,
     )
     _progress("mesh built")
-    rps, spr, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
+    rps, spr, _, _ = _time_rounds(api, dataset, args, cohort["n_rounds"])
     _progress(f"mesh timed: {rps:.3f} rounds/s")
     out = {
         "mesh_shape": {"clients": len(jax.devices())},
@@ -659,7 +675,7 @@ def run_mesh(on_cpu: bool) -> dict:
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
-    rps, spr, _ = _time_rounds(api, dataset, args, n_rounds=3)
+    rps, spr, _, _ = _time_rounds(api, dataset, args, n_rounds=3)
     _progress(f"sweep cohort {c}: {rps:.3f} rounds/s")
     return {
         "clients": c,
@@ -863,10 +879,11 @@ def _main_guarded() -> None:
             with open(stop, "w") as fh:
                 fh.write("round-end bench running\n")
             _progress("tunnel watcher stop-file written")
-        # the watcher kills its in-flight phase child within ~5s of the
-        # stop-file appearing and drops a goodbye marker in its log; a
-        # short grace keeps its teardown off this run's first window
-        time.sleep(6)
+            # the watcher kills its in-flight probe/phase child within
+            # ~5s of the stop-file appearing; a short grace keeps its
+            # teardown off this run's first window. (A pre-existing
+            # stop-file means no watcher can be alive — no grace.)
+            time.sleep(6)
     except OSError:
         pass
     _progress("probing TPU")

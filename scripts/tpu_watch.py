@@ -113,6 +113,22 @@ def _save_capture(cap: dict) -> None:
     os.replace(tmp, CAPTURE_PATH)
 
 
+def _keep_existing(new: dict, old: dict) -> bool:
+    """True when the existing capture carries MORE measured numbers
+    than the retry — covers both a thinner partial (retry died
+    earlier) and an rc=0 all-error retry on a degraded tunnel (every
+    variant raised into ``*_error`` keys). Errors/notes don't count as
+    signal; only measured timings/throughputs do."""
+
+    def signal(d: dict) -> int:
+        return sum(
+            1 for k in d
+            if k.endswith("_ms") or k.endswith("per_sec")
+        )
+
+    return bool(old) and signal(new) < signal(old)
+
+
 def _pending(cap: dict) -> list:
     """Phases still worth attempting: not captured (a PARTIAL capture —
     the child died after flushing some variants — counts as pending so
@@ -133,11 +149,48 @@ def _pending(cap: dict) -> list:
     ]
 
 
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "assert d and d[0].platform != 'cpu', d;"
+    "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum();"
+    "x.block_until_ready();"
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
 def _probe(timeout_s: float) -> bool:
-    ok, note = bench._probe_tpu(timeout_s=timeout_s, attempts=1)
-    if not ok:
-        _log(f"probe: down ({note})")
-    return ok
+    """Backend-init probe in a child, killed within ~5s of the
+    stop-file appearing (bench._probe_tpu's subprocess.run would hold
+    the core for up to the full timeout after a round-end bench asks
+    for the box)."""
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CODE],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=bench._child_env(),
+        )
+        deadline = time.time() + timeout_s
+        while proc.poll() is None:
+            if os.path.exists(STOP_FILE):
+                proc.kill()
+                proc.wait()
+                _log("probe: aborted (stop-file)")
+                return False
+            if time.time() > deadline:
+                proc.kill()
+                proc.wait()
+                _log(f"probe: down (timeout after {timeout_s:.0f}s)")
+                return False
+            time.sleep(5)
+        out = proc.stdout.read() if proc.stdout else ""
+        if proc.returncode == 0 and "PROBE_OK" in out:
+            return True
+        _log(f"probe: down (rc={proc.returncode})")
+        return False
+    except Exception as e:  # noqa: BLE001
+        _log(f"probe: down ({type(e).__name__}: {e})")
+        return False
 
 
 def _run_phase(name: str, phase_args: list, timeout_s: float):
@@ -204,11 +257,20 @@ def main() -> None:
     deadline = time.time() + args.hours * 3600
 
     if os.path.exists(STOP_FILE):
-        # a stale stand-down marker (e.g. from an earlier bench run)
-        # must not veto an explicit new watch — launching the watcher
-        # IS the operator's intent
+        age = time.time() - os.path.getmtime(STOP_FILE)
+        if age < 900:
+            # a FRESH marker likely belongs to an in-flight round-end
+            # bench run (bounded ~10 min) — starting now would create
+            # the very contention the handshake prevents
+            _log(
+                f"stop-file is only {age:.0f}s old (bench may be "
+                "running) — exiting; relaunch after it finishes"
+            )
+            return
+        # a stale marker must not veto an explicit new watch —
+        # launching the watcher IS the operator's intent
         os.unlink(STOP_FILE)
-        _log("stale stop-file cleared at startup")
+        _log(f"stale stop-file ({age:.0f}s old) cleared at startup")
 
     cap = _load_capture()
     _log(
@@ -248,17 +310,19 @@ def main() -> None:
             _log(f"phase {name} (attempt {cap['attempts'][name]}) ...")
             result, note = _run_phase(name, phase_args, timeout_s)
             dt = time.time() - t0
+            if note.startswith("killed by stop-file"):
+                # a box handover is not the phase's (or the tunnel's)
+                # fault — refund the attempt so repeated bench
+                # handovers can never exhaust a healthy phase
+                cap["attempts"][name] -= 1
+                _save_capture(cap)
+                _log(f"phase {name}: aborted by stop-file; attempt refunded")
+                continue
+
             prev = (cap["phases"].get(name) or {}).get("result") or {}
-            if (
-                result is not None
-                and "partial_note" in result
-                and len(result) < len(prev)
-            ):
-                # a retry that died EARLIER than an existing partial
-                # must not clobber the richer capture (a complete rc=0
-                # retry always wins, whatever its key count)
+            if result is not None and _keep_existing(result, prev):
                 result = None
-                note = "thinner partial than existing capture; kept old"
+                note = "fewer measured numbers than existing capture; kept old"
             if result is not None:
                 cap["phases"][name] = {
                     "captured_at": _utcnow(),

@@ -115,8 +115,12 @@ class TestStopFile:
         run) must not veto an explicit new watch — launching the
         watcher IS the operator's intent — but a stop file appearing
         MID-RUN (a round-end bench taking the box) exits promptly."""
+        import time as _time
+
         stop = str(tmp_path / "stop")
-        open(stop, "w").close()  # stale, pre-startup
+        open(stop, "w").close()  # pre-startup marker ...
+        old = _time.time() - 3600
+        os.utime(stop, (old, old))  # ... aged past a bench run's bound
         monkeypatch.setattr(watch, "STOP_FILE", stop)
         monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
         monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
@@ -137,3 +141,37 @@ class TestStopFile:
         )
         watch.main()  # exits via the mid-run stop file, not the deadline
         assert probes == [1]
+
+    def test_fresh_stop_file_defers_startup(self, watch, monkeypatch, tmp_path):
+        """A stop-file younger than a bench run's bound means a
+        round-end bench may be mid-flight — the watcher must defer,
+        not delete the marker and contend."""
+        stop = str(tmp_path / "stop")
+        open(stop, "w").close()  # fresh
+        monkeypatch.setattr(watch, "STOP_FILE", stop)
+        monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
+        monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
+
+        def _no_probe(*a, **k):
+            raise AssertionError("probed despite fresh stop file")
+
+        monkeypatch.setattr(watch, "_probe", _no_probe)
+        monkeypatch.setattr(sys, "argv", ["tpu_watch.py", "--hours", "0.01"])
+        watch.main()
+        assert os.path.exists(stop)  # marker left for the bench run
+
+
+class TestRetryGuard:
+    def test_keep_existing_semantics(self, watch):
+        rich = {"flash_ms": 2.0, "naive_ms": 3.0,
+                "flash_tokens_per_sec": 1.0, "partial_note": "t"}
+        all_error = {"shape": "x", "flash_error": "E", "naive_error": "E",
+                     "score_matrix_mb_avoided": 1.0}
+        complete = {"flash_ms": 2.0, "naive_ms": 3.0, "flash_b256x256_ms": 2.1,
+                    "flash_tokens_per_sec": 1.0, "naive_tokens_per_sec": 1.0,
+                    "flash_b256x256_tokens_per_sec": 1.0}
+        assert watch._keep_existing(all_error, rich)      # errors never clobber
+        assert not watch._keep_existing(complete, rich)   # fuller retry wins
+        assert not watch._keep_existing(rich, {})         # first capture lands
+        thinner = {"flash_ms": 2.0, "partial_note": "t"}
+        assert watch._keep_existing(thinner, rich)
