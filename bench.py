@@ -115,18 +115,23 @@ def _progress(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
+# Backend-init probe snippet — shared with scripts/tpu_watch.py's
+# stop-aware probe so the two can never disagree about "tunnel up".
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "assert d and d[0].platform != 'cpu', d;"
+    "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum();"
+    "x.block_until_ready();"
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
 def _probe_tpu(
     timeout_s: float = PROBE_TIMEOUT_S, attempts: int = PROBE_ATTEMPTS
 ) -> tuple[bool, str]:
     """Initialize the TPU backend in a subprocess (bounded time)."""
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "d = jax.devices();"
-        "assert d and d[0].platform != 'cpu', d;"
-        "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum();"
-        "x.block_until_ready();"
-        "print('PROBE_OK', d[0].platform)"
-    )
+    code = PROBE_CODE
     env = _child_env()
     last = ""
     for attempt in range(attempts):
@@ -875,15 +880,17 @@ def _main_guarded() -> None:
     # with the driver's round-end certification windows
     try:
         stop = os.path.join(_capture_dir(), _STOP_BASENAME)
-        if not os.path.exists(stop):
-            with open(stop, "w") as fh:
-                fh.write("round-end bench running\n")
-            _progress("tunnel watcher stop-file written")
-            # the watcher kills its in-flight probe/phase child within
-            # ~5s of the stop-file appearing; a short grace keeps its
-            # teardown off this run's first window. (A pre-existing
-            # stop-file means no watcher can be alive — no grace.)
-            time.sleep(6)
+        # ALWAYS (re)write: the marker's mtime is what the watcher's
+        # startup staleness check reads — a pre-existing file from an
+        # earlier bench must read fresh again while THIS bench runs,
+        # or a relaunched watcher would clear it mid-certification
+        with open(stop, "w") as fh:
+            fh.write("round-end bench running\n")
+        _progress("tunnel watcher stop-file written")
+        # the watcher kills its in-flight probe/phase child within ~5s
+        # of the marker appearing; a short grace keeps its teardown off
+        # this run's first window
+        time.sleep(6)
     except OSError:
         pass
     _progress("probing TPU")

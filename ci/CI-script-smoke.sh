@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Fast gate: smoke tier minus the slow tail — tests measured >4s carry
-# pytest.mark.slow and run only in the full tier. Measured (round 4,
-# after re-tiering): 116 tests in ~85s cold on a 1-core worker (~30s of
+# pytest.mark.slow and run only in the full tier. Measured (round 5,
+# after re-tiering): 138 tests in ~82s cold on a 1-core worker (~30s of
 # that is jax import + collection; under 60s on any multi-core box).
 # Re-measure with --durations=40 and re-tier when the gate drifts.
 set -e

@@ -149,16 +149,6 @@ def _pending(cap: dict) -> list:
     ]
 
 
-_PROBE_CODE = (
-    "import jax, jax.numpy as jnp;"
-    "d = jax.devices();"
-    "assert d and d[0].platform != 'cpu', d;"
-    "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum();"
-    "x.block_until_ready();"
-    "print('PROBE_OK', d[0].platform)"
-)
-
-
 def _probe(timeout_s: float) -> bool:
     """Backend-init probe in a child, killed within ~5s of the
     stop-file appearing (bench._probe_tpu's subprocess.run would hold
@@ -166,7 +156,7 @@ def _probe(timeout_s: float) -> bool:
     for the box)."""
     try:
         proc = subprocess.Popen(
-            [sys.executable, "-c", _PROBE_CODE],
+            [sys.executable, "-c", bench.PROBE_CODE],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True, env=bench._child_env(),
         )
@@ -310,35 +300,43 @@ def main() -> None:
             _log(f"phase {name} (attempt {cap['attempts'][name]}) ...")
             result, note = _run_phase(name, phase_args, timeout_s)
             dt = time.time() - t0
-            if note.startswith("killed by stop-file"):
+            timed_out = note.startswith("timeout after")  # original note
+            stopped = note.startswith("killed by stop-file")
+            if stopped:
                 # a box handover is not the phase's (or the tunnel's)
                 # fault — refund the attempt so repeated bench
                 # handovers can never exhaust a healthy phase
                 cap["attempts"][name] -= 1
-                _save_capture(cap)
                 _log(f"phase {name}: aborted by stop-file; attempt refunded")
-                continue
 
             prev = (cap["phases"].get(name) or {}).get("result") or {}
             if result is not None and _keep_existing(result, prev):
                 result = None
                 note = "fewer measured numbers than existing capture; kept old"
             if result is not None:
+                # salvaged partials from a stopped/timed-out child are
+                # persisted too — measured numbers from a rare live
+                # window must never be thrown away
                 cap["phases"][name] = {
                     "captured_at": _utcnow(),
                     "wall_s": round(dt, 1),
-                    "attempt": cap["attempts"][name],
+                    "attempt": max(cap["attempts"][name], 1),
                     "result": result,
                 }
                 _save_capture(cap)
-                _log(f"phase {name}: CAPTURED in {dt:.0f}s")
+                _log(f"phase {name}: CAPTURED in {dt:.0f}s ({note})")
             else:
+                _save_capture(cap)  # attempt counter (or refund) sticks
                 _log(f"phase {name}: failed ({note})")
-                if note.startswith("timeout after"):
-                    # wedge check before burning the next phase window
-                    if not _probe(20.0):
-                        _log("tunnel wedged mid-window — back to sleep")
-                        break
+            if stopped:
+                continue  # loop top sees the stop-file and exits
+            if timed_out:
+                # wedge check before burning the next phase window —
+                # keyed on the ORIGINAL note (a salvage/keep-old rewrite
+                # must not mask an observed wedge)
+                if not _probe(20.0):
+                    _log("tunnel wedged mid-window — back to sleep")
+                    break
         time.sleep(30)  # brief settle, then re-probe for remaining phases
 
     _log("deadline reached — exiting")

@@ -567,7 +567,38 @@ class TestEdgeCaseArrays:
         assert float(pm.mean()) > 1.0  # far-tail noise branch
 
 
+    def test_download_seam_invoked_when_missing(self, tmp_path, monkeypatch):
+        """download=True routes through the download seam exactly once
+        when the archive dir is absent (offline grace: a failed fetch
+        leaves the synthetic fallback)."""
+        from fedml_tpu.data import download as dl
+        from fedml_tpu.data import poison
+
+        calls = []
+
+        def fake_download(name, cache_dir):
+            calls.append(name)
+            d = os.path.join(cache_dir, "edge_case_examples")
+            os.makedirs(d, exist_ok=True)
+            imgs = np.random.RandomState(0).randint(
+                0, 256, (4, 32, 32, 3), dtype=np.uint8
+            )
+            with open(os.path.join(d, "southwest_images_new_train.pkl"), "wb") as f:
+                pickle.dump(imgs, f)
+            return True
+
+        monkeypatch.setattr(dl, "download_dataset", fake_download)
+        poison.load_edge_case_arrays.cache_clear()
+        got = poison.load_edge_case_arrays(
+            str(tmp_path), "southwest", download=True
+        )
+        assert calls == ["edge_case_examples"]
+        assert got is not None and got.shape == (4, 32, 32, 3)
+        poison.load_edge_case_arrays.cache_clear()
+
+
 class TestFets2021:
+    @pytest.mark.slow  # deeplab conv training is ~2 min on the 1-core box
     def test_standin_loads_and_trains(self, args_factory):
         """FeTS2021 (data/FeTS2021/download.sh): 4-channel MRI-modality
         segmentation federation; the stand-in exercises the full
