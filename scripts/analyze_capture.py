@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Readout over the tunnel-watcher's capture file.
+
+Turns ``BENCH_TPU_CAPTURE_r05.json`` (written phase-by-phase by
+``scripts/tpu_watch.py`` as tunnel windows open) into the optimization
+narrative VERDICT r4 asked for: the dense cohort's MFU against the
+chip's bf16 roofline with XLA's own buffer plan, the flash-vs-naive
+long-context verdict with the block-size tuning table, the bf16
+speedup, the scaling sweep's retention, and the mesh-vs-vmap overhead.
+
+Usage: python scripts/analyze_capture.py [path]
+"""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402
+
+
+def _get(phases, name):
+    return (phases.get(name) or {}).get("result") or {}
+
+
+def main() -> None:
+    path = (
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else os.path.join(_REPO, bench._CAPTURE_BASENAME)
+    )
+    if not os.path.exists(path):
+        print(f"no capture at {path} — the tunnel has not answered yet")
+        return
+    with open(path) as fh:
+        cap = json.load(fh)
+    phases = cap.get("phases") or {}
+    print(f"capture: {os.path.basename(path)} — phases: {sorted(phases)}\n")
+
+    dense = _get(phases, "dense")
+    if dense:
+        print("== dense (ResNet-18/CIFAR-10 bf16 — the north-star cohort) ==")
+        print(f"  rounds/s            : {dense.get('rounds_per_sec')}")
+        print(f"  samples/s/chip      : {dense.get('samples_per_sec_per_chip')}")
+        mfu = dense.get("mfu_vs_bf16_peak")
+        if mfu is not None:
+            peak = dense.get("peak_assumed_tflops")
+            print(f"  MFU vs bf16 peak    : {mfu:.2%} (peak {peak} TF/s)")
+            verdict = (
+                "MXU well fed" if mfu >= 0.2 else
+                "compute-starved — check buffer plan below" if mfu >= 0.05
+                else "latency/HBM-bound — grow batch geometry or fuse"
+            )
+            print(f"  -> {verdict}")
+        ma = dense.get("xla_memory_analysis") or {}
+        if ma:
+            print(
+                f"  XLA buffers         : temp {ma.get('xla_temp_mb')} MB / "
+                f"args {ma.get('xla_argument_mb')} MB / "
+                f"out {ma.get('xla_output_mb')} MB"
+            )
+            if (ma.get("xla_temp_mb") or 0) > 4 * (ma.get("xla_argument_mb") or 1):
+                print("  -> temp-dominated: remat / layout first")
+            else:
+                print("  -> argument-dominated: batch geometry has headroom")
+        if dense.get("hbm_used_gb") is not None:
+            print(
+                f"  HBM                 : {dense['hbm_used_gb']} / "
+                f"{dense.get('hbm_limit_gb', '?')} GB"
+            )
+        print()
+
+    lc = _get(phases, "longctx")
+    if lc:
+        print(f"== longctx ({lc.get('shape')}, {lc.get('dtype')}) ==")
+        for k in sorted(lc):
+            if k.endswith("_ms"):
+                name = k[: -len("_ms")]
+                tps = lc.get(f"{name}_tokens_per_sec")
+                print(f"  {name:<16}: {lc[k]:>8} ms/step  ({tps} tok/s)")
+        sp = lc.get("flash_speedup_vs_naive")
+        if sp is not None:
+            verdict = (
+                "flash kernel earns its keep" if sp > 1.05 else
+                "parity — kernel is optional" if sp > 0.95 else
+                "flash LOSES — demote to option or retune (VERDICT r4 #4)"
+            )
+            print(f"  flash vs naive  : {sp}x -> {verdict}")
+        if lc.get("best_flash_config"):
+            print(f"  best block cfg  : {lc['best_flash_config']}")
+        for k in sorted(lc):
+            if k.endswith("_error"):
+                print(f"  {k}: {lc[k][:80]}")
+        print()
+
+    head = _get(phases, "headline")
+    bf16 = _get(phases, "bf16")
+    if head:
+        print("== headline (32-client CNN cohort) ==")
+        print(f"  rounds/s        : {head.get('value')}")
+        print(f"  vs sequential   : {head.get('vs_baseline')}x")
+        note = (head.get("detail") or {}).get("vs_baseline_note")
+        if note:
+            print(f"  note            : {note}")
+        if bf16.get("rounds_per_sec") and head.get("value"):
+            print(
+                f"  bf16 speedup    : "
+                f"{bf16['rounds_per_sec'] / head['value']:.2f}x"
+            )
+        print()
+
+    sweep = sorted(
+        (
+            (int(n.split("_")[1]), _get(phases, n))
+            for n in phases
+            if n.startswith("sweep_")
+        ),
+    )
+    if sweep:
+        print("== scaling sweep ==")
+        base_c, base = sweep[0]
+        base_sps = max(base.get("samples_per_sec", 0), 1e-9)
+        for c, e in sweep:
+            # a salvaged all-error entry has no measured numbers —
+            # report it as such instead of dying mid-readout
+            rps = e.get("rounds_per_sec")
+            if rps is None:
+                errs = [k for k in e if k.endswith("_error") or k == "partial_note"]
+                print(f"  {c:>4} clients: no measured numbers ({', '.join(errs) or 'empty'})")
+                continue
+            sps = e.get("samples_per_sec", 0)
+            print(
+                f"  {c:>4} clients: {rps:>9} rounds/s  "
+                f"{sps:>12} samples/s  retention {sps / base_sps:.3f}"
+            )
+        print()
+
+    mesh = _get(phases, "mesh")
+    if mesh and head.get("value"):
+        ratio = mesh.get("rounds_per_sec", 0) / max(head["value"], 1e-9)
+        print("== mesh simulator vs vmap engine (same cohort) ==")
+        print(
+            f"  mesh {mesh.get('mesh_shape')}: {mesh.get('rounds_per_sec')} "
+            f"rounds/s = {ratio:.2f}x of the vmap engine"
+        )
+        print()
+
+
+if __name__ == "__main__":
+    main()
