@@ -69,6 +69,10 @@ PHASES = [
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 
+# Note produced by _run_phase on a stand-down kill; main()'s refund /
+# exit logic keys on it (one constant, no string drift).
+STOP_NOTE = "killed by stop-file (box handed over)"
+
 
 def _utcnow() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime(
@@ -211,7 +215,7 @@ def _run_phase(name: str, phase_args: list, timeout_s: float):
                 if os.path.exists(STOP_FILE):
                     proc.kill()
                     proc.wait()
-                    note = "killed by stop-file (box handed over)"
+                    note = STOP_NOTE
                     break
                 time.sleep(5)
             errf.seek(0)
@@ -301,7 +305,7 @@ def main() -> None:
             result, note = _run_phase(name, phase_args, timeout_s)
             dt = time.time() - t0
             timed_out = note.startswith("timeout after")  # original note
-            stopped = note.startswith("killed by stop-file")
+            stopped = note == STOP_NOTE
             if stopped:
                 # a box handover is not the phase's (or the tunnel's)
                 # fault — refund the attempt so repeated bench

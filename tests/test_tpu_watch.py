@@ -17,6 +17,17 @@ pytestmark = pytest.mark.smoke
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture
+def patched_paths(watch, monkeypatch, tmp_path):
+    """Redirect every watcher path into tmp so main()-driving tests can
+    never touch the real repo-root capture/log/stop files."""
+    stop = str(tmp_path / "stop")
+    monkeypatch.setattr(watch, "STOP_FILE", stop)
+    monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
+    monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
+    return stop
+
+
 @pytest.fixture(scope="module")
 def watch():
     spec = importlib.util.spec_from_file_location(
@@ -109,7 +120,7 @@ class TestPendingSelection:
 
 class TestStopFile:
     def test_stale_stop_cleared_then_midrun_stop_honored(
-        self, watch, monkeypatch, tmp_path
+        self, watch, monkeypatch, patched_paths
     ):
         """A stale stand-down marker (e.g. left by an earlier bench
         run) must not veto an explicit new watch — launching the
@@ -117,13 +128,10 @@ class TestStopFile:
         MID-RUN (a round-end bench taking the box) exits promptly."""
         import time as _time
 
-        stop = str(tmp_path / "stop")
+        stop = patched_paths
         open(stop, "w").close()  # pre-startup marker ...
         old = _time.time() - 3600
         os.utime(stop, (old, old))  # ... aged past a bench run's bound
-        monkeypatch.setattr(watch, "STOP_FILE", stop)
-        monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
-        monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
         probes = []
 
         def fake_probe(*a, **k):
@@ -142,15 +150,12 @@ class TestStopFile:
         watch.main()  # exits via the mid-run stop file, not the deadline
         assert probes == [1]
 
-    def test_fresh_stop_file_defers_startup(self, watch, monkeypatch, tmp_path):
+    def test_fresh_stop_file_defers_startup(self, watch, monkeypatch, patched_paths):
         """A stop-file younger than a bench run's bound means a
         round-end bench may be mid-flight — the watcher must defer,
         not delete the marker and contend."""
-        stop = str(tmp_path / "stop")
+        stop = patched_paths
         open(stop, "w").close()  # fresh
-        monkeypatch.setattr(watch, "STOP_FILE", stop)
-        monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
-        monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
 
         def _no_probe(*a, **k):
             raise AssertionError("probed despite fresh stop file")
@@ -175,3 +180,36 @@ class TestRetryGuard:
         assert not watch._keep_existing(rich, {})         # first capture lands
         thinner = {"flash_ms": 2.0, "partial_note": "t"}
         assert watch._keep_existing(thinner, rich)
+
+
+class TestHandoverMidPhase:
+    def test_refund_persists_salvaged_partial(
+        self, watch, monkeypatch, tmp_path, patched_paths
+    ):
+        """A bench handover mid-phase must (a) keep the salvaged
+        partial — measured numbers from a rare live window are never
+        thrown away — (b) refund the attempt, and (c) exit before the
+        next phase (review r5)."""
+        stop = patched_paths
+        monkeypatch.setattr(watch, "_probe", lambda *a, **k: True)
+        ran = []
+
+        def fake_run_phase(name, args, timeout_s):
+            ran.append(name)
+            open(stop, "w").close()  # bench takes the box mid-phase
+            # the REAL note constant: drift between _run_phase's note
+            # and main's check must fail this test
+            return ({"flash_ms": 2.2, "partial_note": "killed"}, watch.STOP_NOTE)
+
+        monkeypatch.setattr(watch, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(
+            sys, "argv", ["tpu_watch.py", "--hours", "0.05", "--interval", "1"]
+        )
+        watch.main()
+        assert ran == ["dense"]  # highest-priority phase only, then exit
+        with open(str(tmp_path / "cap.json")) as f:
+            cap = json.load(f)
+        assert cap["phases"]["dense"]["result"]["flash_ms"] == 2.2  # (a)
+        assert cap["attempts"]["dense"] == 0  # (b) refunded
+        # and the partial stays pending for the next watcher incarnation
+        assert "dense" in [n for n, _, _ in watch._pending(cap)]
