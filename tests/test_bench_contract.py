@@ -52,24 +52,41 @@ class TestSchema:
 
 
 class TestPhaseChild:
-    @pytest.mark.slow  # subprocess + jax import + tiny interpret run
-    def test_longctx_cpu_child_writes_valid_json(self):
+    def _run_child(self, phase: str, timeout: int) -> dict:
+        """Invoke one --cpu phase child exactly as the parent/watcher
+        do and return its JSON — ONE copy of the invocation contract,
+        so a changed flag or env requirement breaks every phase test."""
         with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
             out = f.name
         try:
             r = subprocess.run(
-                [sys.executable, BENCH, "--phase", "longctx", "--cpu",
+                [sys.executable, BENCH, "--phase", phase, "--cpu",
                  "--out", out],
-                capture_output=True, text=True, timeout=240, cwd=REPO,
+                capture_output=True, text=True, timeout=timeout, cwd=REPO,
             )
             assert r.returncode == 0, r.stderr[-800:]
             with open(out) as fh:
-                d = json.load(fh)
-            for k in ("flash_ms", "naive_ms", "flash_speedup_vs_naive",
-                      "score_matrix_mb_avoided"):
-                assert k in d
+                return json.load(fh)
         finally:
             os.unlink(out)
+
+    @pytest.mark.slow  # subprocess + jax import + tiny interpret run
+    def test_longctx_cpu_child_writes_valid_json(self):
+        d = self._run_child("longctx", 240)
+        for k in ("flash_ms", "naive_ms", "flash_speedup_vs_naive",
+                  "score_matrix_mb_avoided"):
+            assert k in d
+        # tuning variants are TPU-only (--tune) — interpreter-mode
+        # timings would mislead the block-size decision
+        assert not any(k.startswith("flash_b") for k in d)
+
+    @pytest.mark.slow  # subprocess + 2-virtual-device mesh round
+    def test_mesh_cpu_child_writes_valid_json(self):
+        d = self._run_child("mesh", 300)
+        assert d["mesh_shape"] == {"clients": 2}
+        assert d["rounds_per_sec"] > 0
+        # a --cpu mesh JSON must never read as a TPU number
+        assert d["cpu_fallback"] is True
 
 
 class TestCaptureSidecar:
