@@ -874,25 +874,32 @@ def _demote_fallback(result: dict, note: str) -> None:
     result["error"] = f"TPU unavailable, CPU fallback: {note}"
 
 
-def _main_guarded() -> None:
-    # a full bench run owns the box (1 core here): signal the tunnel
-    # watcher to stand down so its probe/phase children cannot contend
-    # with the driver's round-end certification windows
+def request_watcher_standdown(reason: str = "bench running") -> None:
+    """Ask the tunnel watcher to stand down: (re)write the stop marker
+    and grant a short grace. Used by any process about to own the box
+    (round-end bench, scripts/reproduce_baseline.py).
+
+    ALWAYS (re)write: the marker's mtime is what the watcher's startup
+    staleness check reads — a pre-existing file from an earlier run
+    must read fresh again while THIS one runs, or a relaunched watcher
+    would clear it mid-flight. The watcher kills its in-flight
+    probe/phase child within ~5s of the marker appearing; the grace
+    keeps its teardown off the caller's first window."""
     try:
         stop = os.path.join(_capture_dir(), _STOP_BASENAME)
-        # ALWAYS (re)write: the marker's mtime is what the watcher's
-        # startup staleness check reads — a pre-existing file from an
-        # earlier bench must read fresh again while THIS bench runs,
-        # or a relaunched watcher would clear it mid-certification
         with open(stop, "w") as fh:
-            fh.write("round-end bench running\n")
-        _progress("tunnel watcher stop-file written")
-        # the watcher kills its in-flight probe/phase child within ~5s
-        # of the marker appearing; a short grace keeps its teardown off
-        # this run's first window
+            fh.write(reason + "\n")
         time.sleep(6)
     except OSError:
         pass
+
+
+def _main_guarded() -> None:
+    # a full bench run owns the box (1 core here): the watcher's
+    # probe/phase children must not contend with the driver's
+    # round-end certification windows
+    request_watcher_standdown("round-end bench running")
+    _progress("tunnel watcher stop-file written")
     _progress("probing TPU")
     tpu_ok, note = _probe_tpu()
     _progress(f"probe: ok={tpu_ok} ({note})")
