@@ -78,7 +78,13 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # The child-phase vocabulary — shared with scripts/tpu_watch.py (and
 # its drift test) so a renamed phase can never silently burn tunnel
 # windows on rc!=0 children.
-PHASE_CHOICES = ("headline", "bf16", "dense", "sweep", "longctx", "mesh")
+PHASE_CHOICES = (
+    "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline"
+)
+
+# round-pipeline depths the pipeline phase measures; the contract key
+# set (k1/k2/k4) tests and docs pin against
+_PIPELINE_KS = (1, 2, 4)
 
 
 def _capture_dir() -> str:
@@ -677,6 +683,78 @@ def run_mesh(on_cpu: bool) -> dict:
     return out
 
 
+def run_pipeline(on_cpu: bool, smoke: bool = False) -> dict:
+    """Round-pipeline phase: the async K-rounds-in-flight executor
+    (core/round_pipeline.py) driven end-to-end through ``train()`` at
+    K ∈ {1,2,4} on one cohort. Reports rounds/s per depth plus the
+    executor's own host-syncs-per-round figure — the zero-sync hot-loop
+    claim as a measured number, and the K=4 ≥ K=1 check as a ratio.
+
+    ``smoke`` (CI gate): K=2 only, 6 rounds — exercises the pipeline
+    plumbing in seconds; no cross-K comparison."""
+    import jax
+
+    if smoke:
+        # LR/MNIST-shape: the CI gate needs seconds, not a CNN compile
+        ks, n_rounds = (2,), 6
+        cohort = dict(
+            n_clients=4, epochs=1, per_client=50,
+            dataset="mnist", model="lr",
+        )
+    elif on_cpu:
+        # demoted fallback: small LR cohort — a CNN x 3 depths x 12
+        # rounds blows past the phase window on a 1-core box, and the
+        # K-vs-K ratio (dispatch overlap) is what the phase measures
+        ks, n_rounds = _PIPELINE_KS, 12
+        cohort = dict(
+            n_clients=8, epochs=1, per_client=100,
+            dataset="mnist", model="lr",
+        )
+    else:
+        ks, n_rounds = _PIPELINE_KS, 30
+        cohort = dict(n_clients=32, epochs=1, per_client=200)
+    out = {
+        "cohort_clients": cohort["n_clients"],
+        "rounds_timed": n_rounds,
+        "device": str(jax.devices()[0]),
+    }
+    extra = {k: v for k, v in cohort.items()
+             if k not in ("n_clients", "epochs", "per_client")}
+    # ONE api for every depth: pipeline_depth is host-side loop logic,
+    # so the round/eval jits compile once and all K runs reuse them —
+    # on a TPU window that's one compile cycle instead of three
+    args, dataset, _model, api = _build_api(
+        cohort["n_clients"],
+        cohort["epochs"],
+        per_client=cohort["per_client"],
+        comm_round=1,
+        frequency_of_the_test=max(2, n_rounds // 3),
+        **extra,
+    )
+    api.train()  # warmup: compiles round + eval fns outside the clock
+    args.comm_round = n_rounds
+    for k in ks:
+        args.pipeline_depth = k
+        t0 = time.perf_counter()
+        api.train()
+        dt = time.perf_counter() - t0
+        out[f"k{k}"] = {
+            "rounds_per_sec": round(n_rounds / dt, 4),
+            "host_syncs_per_round": api.pipeline_stats.get(
+                "host_syncs_per_round"
+            ),
+            "compile_bucket": api.pipeline_stats.get("bucket"),
+        }
+        _progress(f"pipeline k={k}: {n_rounds / dt:.3f} rounds/s")
+    if "k4" in out and "k1" in out:
+        out["speedup_k4_vs_k1"] = round(
+            out["k4"]["rounds_per_sec"]
+            / max(out["k1"]["rounds_per_sec"], 1e-9),
+            3,
+        )
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -768,6 +846,9 @@ _HEADLINE_TIMEOUT_S = 270.0
 # the ResNet cohort's FIRST TPU compile alone can take a minute —
 # size the window for compile + 3 timed rounds, not just the rounds
 _DENSE_TIMEOUT_S = 170.0
+# one warmup compile + three timed train() runs (K=1/2/4) on the same
+# jitted fns; sized like the watcher's window for the first TPU compile
+_PIPELINE_TIMEOUT_S = 300.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1018,6 +1099,31 @@ def _main_guarded() -> None:
     else:
         result["detail"]["dense_skipped"] = "budget exhausted"
 
+    # round-pipeline phase (K ∈ {1,2,4} rounds in flight): like dense it
+    # runs demoted on the CPU fallback so detail.pipeline is always
+    # populated — the K=4 vs K=1 ratio is the async executor's headline
+    if _BUDGET_S - _elapsed() > 60:
+        on_tpu = _tunnel_usable()
+        remaining = _BUDGET_S - _elapsed()
+        pipe_args = ["--phase", "pipeline"] + ([] if on_tpu else ["--cpu"])
+        pipe, pnote = (
+            (None, "budget exhausted after probe")
+            if remaining < 40
+            else _run_phase_subprocess(
+                pipe_args, min(_PIPELINE_TIMEOUT_S, remaining - 10)
+            )
+        )
+        if pipe is not None:
+            if not on_tpu:
+                pipe["cpu_fallback"] = True
+            result["detail"]["pipeline"] = pipe
+        else:
+            _note_phase_outcome(pnote)
+            result["detail"]["pipeline_skipped"] = pnote
+            _progress(f"pipeline phase skipped ({pnote})")
+    else:
+        result["detail"]["pipeline_skipped"] = "budget exhausted"
+
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
         # cohort big enough to wedge the tunnel can only cost itself
@@ -1131,6 +1237,8 @@ def _phase_main(argv) -> None:
     p.add_argument("--cohort", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--tune", action="store_true")
+    # pipeline phase, CI gate: K=2 only, 6 rounds (seconds, not minutes)
+    p.add_argument("--smoke", action="store_true")
     p.add_argument("--out", required=True)
     a = p.parse_args(argv)
     if a.cpu:
@@ -1148,6 +1256,8 @@ def _phase_main(argv) -> None:
         out = run_longctx(on_cpu=a.cpu, out_path=a.out, tune=a.tune)
     elif a.phase == "mesh":
         out = run_mesh(on_cpu=a.cpu)
+    elif a.phase == "pipeline":
+        out = run_pipeline(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

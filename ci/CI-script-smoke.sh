@@ -7,3 +7,13 @@
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -m "smoke and not slow" -q "$@"
+
+# Round-pipeline smoke (K=2, 6 rounds, CPU): the async executor must run
+# end-to-end through bench.py's pipeline phase child and emit the
+# detail.pipeline contract keys. The contract lives in ONE place —
+# tests/test_bench_contract.py — and is invoked here by node id (which
+# runs it despite its slow marker, kept so the plain fast gate above
+# doesn't pay the ~7s bench child twice).
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_pipeline_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

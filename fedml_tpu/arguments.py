@@ -65,6 +65,10 @@ _DEFAULTS: Dict[str, Any] = {
     "server_momentum": 0.0,
     # fedprox / fednova
     "fedprox_mu": 0.0,
+    # simulation engine mode: "vectorized" (vmap the cohort — the TPU
+    # path, driven by the async round pipeline) or "sequential"
+    # (python loop per client — the reference's shape, debug/parity)
+    "sim_mode": "vectorized",
     # straggler handling (cross-silo; beyond the reference): aggregate
     # whoever reported within this many seconds of the round broadcast,
     # reweighted over the subset. 0 = wait for everyone (reference).
@@ -109,6 +113,18 @@ _DEFAULTS: Dict[str, Any] = {
     # forward/backward matmuls in the MXU's native format with f32
     # master weights, optimizer state, and loss reductions
     "dtype": "float32",
+    # async round pipeline (core/round_pipeline.py): how many federation
+    # rounds may be in flight at once. 1 = synchronous (identical
+    # metrics, flushed every eval round); K>1 defers metric fetches so
+    # the hot loop has zero host syncs between flushes
+    "pipeline_depth": 1,
+    # compile-cache bucket policy for cohort sizes: "pow2" pads the
+    # sampled cohort up to the next power of two (zero-weight,
+    # fully-masked padding) so cohort-size changes hit the jit cache;
+    # "exact" disables padding (auto-selected for weight-unaware
+    # aggregation, e.g. defense_type=median or a custom
+    # server_aggregator)
+    "pipeline_bucket": "pow2",
     # mesh axes -> sizes. Scenario-specific vocabulary: the distributed
     # platform (distributed.py) takes {dp/tp/ep} | {sp} | {pp}; the
     # MESH simulation backend (simulation/simulator.py) takes
@@ -243,8 +259,24 @@ class Arguments:
             "epochs",
             "batch_size",
             "random_seed",
+            "pipeline_depth",
         ):
             setattr(self, int_key, int(getattr(self, int_key)))
+        if getattr(self, "pipeline_depth", 1) < 1:
+            raise ValueError(
+                f"pipeline_depth={self.pipeline_depth}: must be >= 1 "
+                "(1 = synchronous round loop)"
+            )
+        if getattr(self, "pipeline_bucket", "pow2") not in ("pow2", "exact"):
+            raise ValueError(
+                f"pipeline_bucket {self.pipeline_bucket!r}: pick 'pow2' or 'exact'"
+            )
+        if getattr(self, "sim_mode", "vectorized") not in (
+            "vectorized", "sequential",
+        ):
+            raise ValueError(
+                f"sim_mode {self.sim_mode!r}: pick 'vectorized' or 'sequential'"
+            )
         for float_key in (
             "learning_rate",
             "server_lr",

@@ -19,3 +19,4 @@ from .partition import (  # noqa: F401
     homo_partition,
     record_data_stats,
 )
+from .round_pipeline import RoundPipeline, bucket_cohort  # noqa: F401

@@ -35,8 +35,19 @@ def unstack_pytrees(stacked: Params, count: int) -> List[Params]:
     return [jax.tree.map(lambda x: x[i], stacked) for i in range(count)]
 
 
-def normalize_weights(sample_nums: jax.Array) -> jax.Array:
+def normalize_weights(
+    sample_nums: jax.Array, valid: Optional[jax.Array] = None
+) -> jax.Array:
+    """Sample counts -> normalized FedAvg weights.
+
+    ``valid`` (optional, [C] in {0,1}) zeroes the weight of padded
+    cohort slots — the shape-bucketed compile cache
+    (``core/round_pipeline.py``) pads cohorts up to bucket sizes and
+    padding must be aggregation-invisible. Runs inside the donated
+    round computation: pure, no aliasing of its inputs."""
     w = sample_nums.astype(jnp.float32)
+    if valid is not None:
+        w = w * valid.astype(jnp.float32)
     return w / jnp.maximum(w.sum(), 1.0)
 
 

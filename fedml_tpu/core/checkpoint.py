@@ -86,7 +86,12 @@ class RoundCheckpointer:
                 args=self._ocp.args.StandardRestore(jax.tree.map(to_ref, target)),
             )
         else:
-            state = self.manager.restore(step)
+            # explicit StandardRestore: newer orbax refuses a bare
+            # manager.restore(step) ("provide CheckpointArgs"); the
+            # target-free form restores the raw saved tree (host numpy)
+            state = self.manager.restore(
+                step, args=self._ocp.args.StandardRestore()
+            )
         logging.info("checkpoint restored from round %d", step)
         return state
 

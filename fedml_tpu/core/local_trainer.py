@@ -99,6 +99,15 @@ def make_local_train_fn(
 
     ``metrics`` carries the last epoch's summed ``loss_sum`` /
     ``correct`` / ``count`` so callers can weight by true sample count.
+
+    Donation contract: the function is pure in its arguments — it never
+    aliases ``params`` into its outputs' buffers itself, so the round
+    engine may donate the global params/opt-state buffers it closes
+    over, and the round-pipeline executor (``core/round_pipeline.py``)
+    may keep K dispatched rounds in flight. Metric leaves are f32
+    device scalars regardless of ``compute_dtype`` — the deferred-
+    metrics ring accumulates them across rounds, and bf16 sums would
+    drift.
     """
 
     def batch_loss(params, global_params, x, y, mask):
@@ -146,9 +155,11 @@ def make_local_train_fn(
             b = _shuffle_batches(batches, ep_rng) if shuffle else batches
             (p, s), metrics = jax.lax.scan(train_step, (p, s), (b.x, b.y, b.mask))
             summed = {
-                "loss_sum": (metrics["loss"] * metrics["count"]).sum(),
-                "correct": metrics["correct"].sum(),
-                "count": metrics["count"].sum(),
+                "loss_sum": (metrics["loss"] * metrics["count"])
+                .sum()
+                .astype(jnp.float32),
+                "correct": metrics["correct"].sum().astype(jnp.float32),
+                "count": metrics["count"].sum().astype(jnp.float32),
             }
             return (p, s), summed
 

@@ -135,6 +135,49 @@ class device_trace:
 Sink = Callable[[Dict[str, Any]], None]
 
 
+class DeferredMetrics:
+    """Device-resident metric ring for the round pipeline.
+
+    The round-pipeline executor (``core/round_pipeline.py``) keeps its
+    hot loop free of host syncs: per-round metric scalars stay on
+    device and are ``push``ed here; ``flush`` materializes every pending
+    record in ONE device fetch. ``host_syncs`` counts those fetches —
+    the instrumentation the zero-sync-between-flushes test asserts on.
+
+    Contract: ``push`` never touches device values; ``flush(upto)``
+    fetches (and removes) all records with ``round_idx <= upto`` (None
+    = everything, the drain case) and returns ``[(round_idx, host_tree),
+    ...]`` in push order, where ``host_tree`` holds numpy scalars.
+    """
+
+    def __init__(self) -> None:
+        self._pending: List[Any] = []  # [(round_idx, device_tree)]
+        self.host_syncs = 0
+        self.flushes = 0
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def push(self, round_idx: int, device_tree: Any) -> None:
+        self._pending.append((round_idx, device_tree))
+
+    def flush(self, upto: Optional[int] = None):
+        ready = [
+            (r, t) for r, t in self._pending if upto is None or r <= upto
+        ]
+        if not ready:
+            return []
+        self._pending = [
+            (r, t) for r, t in self._pending if not (upto is None or r <= upto)
+        ]
+        import jax
+
+        host = jax.device_get([t for _, t in ready])  # ONE fetch for all
+        self.host_syncs += 1
+        self.flushes += 1
+        return list(zip([r for r, _ in ready], host))
+
+
 class MetricsReporter:
     """Round/train/test metrics to pluggable sinks."""
 

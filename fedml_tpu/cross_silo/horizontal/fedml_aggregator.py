@@ -154,10 +154,13 @@ class FedMLAggregator:
         participating client."""
         if data_silo_num_in_total == client_num_in_total:
             return list(range(data_silo_num_in_total))
-        np.random.seed(round_idx)
-        return np.random.choice(
-            range(data_silo_num_in_total), client_num_in_total, replace=False
-        ).tolist()
+        # local RandomState: identical MT19937 draws to the reference's
+        # np.random.seed(round_idx), no global RNG side effect
+        return (
+            np.random.RandomState(round_idx)
+            .choice(range(data_silo_num_in_total), client_num_in_total, replace=False)
+            .tolist()
+        )
 
     def client_selection(
         self, round_idx: int, client_id_list_in_total: List, client_num_per_round: int
@@ -166,10 +169,11 @@ class FedMLAggregator:
         fedml_server_manager.py:33)."""
         if client_num_per_round >= len(client_id_list_in_total):
             return list(client_id_list_in_total)
-        np.random.seed(round_idx)
-        return np.random.choice(
-            client_id_list_in_total, client_num_per_round, replace=False
-        ).tolist()
+        return (
+            np.random.RandomState(round_idx)
+            .choice(client_id_list_in_total, client_num_per_round, replace=False)
+            .tolist()
+        )
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict]:
         if self.test_data is None:

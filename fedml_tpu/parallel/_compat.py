@@ -1,0 +1,46 @@
+"""jax version compatibility for the parallel package.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma``) across jax releases. The parallel
+modules import it from here so the package imports — and the rest of
+the simulator with it — on either side of that move.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` kwarg
+    translated to whatever the installed jax understands.
+
+    On pre-vma jax the replication checker predates the ``pcast``-based
+    varying annotations this package's kernels carry, so bodies that
+    type-check under vma can raise spurious rep errors — default the
+    legacy checker off unless the caller asked for it explicitly."""
+    if not _HAS_VMA:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        kwargs.setdefault("check_rep", False)
+    elif "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
+
+
+def pcast(x, axis_name, *, to):
+    """``jax.lax.pcast`` where it exists (the vma type system); identity
+    on older jax, whose shard_map has no varying/invariant typing."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

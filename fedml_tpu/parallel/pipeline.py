@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._compat import pcast, shard_map
 
 
 def stack_stage_params(per_stage: list) -> Any:
@@ -92,7 +92,7 @@ def pipeline_apply(
         # x arrives replicated (device-invariant); the scan carry is
         # device-varying (each stage holds different activations), so
         # mark everything feeding it as varying over the pp axis
-        x = lax.pcast(x, axis, to="varying")
+        x = pcast(x, axis, to="varying")
         s = lax.axis_index(axis)
         perm = [(i, i + 1) for i in range(S - 1)]  # non-cyclic: stage s -> s+1
 

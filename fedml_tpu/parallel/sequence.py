@@ -202,8 +202,9 @@ def make_sequence_sharded_attention(
     over that mesh axis (each dp replica runs its own ring/all-to-all
     over the sp axis; without it, a multi-axis mesh would gather the
     dp-sharded batch at the shard_map boundary)."""
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ._compat import shard_map
 
     strategies = {"ring": ring_attention, "ulysses": ulysses_attention}
     if strategy not in strategies:

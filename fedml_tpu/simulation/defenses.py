@@ -204,9 +204,11 @@ class SFedAvgAPI(FedAvgAPI):
         else:
             p = np.ones((client_num_in_total,))
         p = p / (p.sum() + 1e-13)
-        np.random.seed(round_idx)
+        # local RandomState: identical draws to np.random.seed(round_idx)
+        # without clobbering the caller's global NumPy RNG
+        rs = np.random.RandomState(round_idx)
         return np.asarray(
-            np.random.choice(
+            rs.choice(
                 range(client_num_in_total), client_num_per_round, replace=False, p=p
             ),
             dtype=np.int32,

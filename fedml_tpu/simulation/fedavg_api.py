@@ -63,15 +63,16 @@ def _take(b: Batches, idx: jax.Array) -> Batches:
 def deterministic_client_sampling(
     round_idx: int, client_num_in_total: int, client_num_per_round: int
 ) -> np.ndarray:
-    """Reference determinism contract: ``np.random.seed(round_idx)``
-    then ``choice`` without replacement (FedAVGAggregator.py:99-113)."""
+    """Reference determinism contract (FedAVGAggregator.py:99-113):
+    MT19937 seeded with ``round_idx``, ``choice`` without replacement —
+    via a local ``RandomState`` so the draws are identical to the
+    reference's ``np.random.seed(round_idx)`` without clobbering the
+    caller's global NumPy RNG state."""
     if client_num_in_total == client_num_per_round:
         return np.arange(client_num_in_total, dtype=np.int32)
-    np.random.seed(round_idx)
+    rs = np.random.RandomState(round_idx)
     return np.asarray(
-        np.random.choice(
-            range(client_num_in_total), client_num_per_round, replace=False
-        ),
+        rs.choice(range(client_num_in_total), client_num_per_round, replace=False),
         dtype=np.int32,
     )
 
@@ -132,6 +133,9 @@ class FedAvgAPI:
                 "vectorized mode; sim_mode='sequential' is not supported"
             )
         self.history: List[Dict[str, float]] = []
+        # populated by core/round_pipeline.py after train(): depth,
+        # bucket, flushes, host_syncs_per_round
+        self.pipeline_stats: Dict[str, Any] = {}
 
         self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
         self.rng, init_rng = jax.random.split(self.rng)
@@ -230,12 +234,31 @@ class FedAvgAPI:
 
     # -- engine -------------------------------------------------------
     def _build_jitted(self) -> None:
+        # incremented at TRACE time (the python body runs only when jit
+        # retraces) — the compile-count regression tests read this
+        self._round_trace_count = 0
+
         def round_fn(
             global_params, server_state, packed: Batches, nsamples, idx, rng,
-            lr_mult=1.0,
+            lr_mult=1.0, valid=None,
         ):
+            self._round_trace_count += 1
             cohort = _take(packed, idx)
             ns = jnp.take(nsamples, idx)
+            if valid is not None:
+                # shape-bucketed cohorts (core/round_pipeline.py): the
+                # padded slots repeat a real client index; zeroing their
+                # batch mask makes every batch fully-masked (local
+                # training reverts params exactly, metrics count 0) and
+                # normalize_weights(..., valid) gives them aggregation
+                # weight 0 — the same invisibility contract as
+                # parallel/mesh.py's pad_federation
+                vm = valid.reshape((-1,) + (1,) * (cohort.mask.ndim - 1))
+                cohort = Batches(
+                    x=cohort.x,
+                    y=cohort.y,
+                    mask=cohort.mask * vm.astype(cohort.mask.dtype),
+                )
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -261,7 +284,7 @@ class FedAvgAPI:
                 new_stacked, train_metrics = jax.vmap(
                     self._local_train, in_axes=(None, 0, 0)
                 )(global_params, cohort, rngs)
-            weights = normalize_weights(ns)
+            weights = normalize_weights(ns, valid)
             new_global, new_state = self._aggregate(
                 global_params, server_state, new_stacked, weights, cohort, rng
             )
@@ -329,6 +352,26 @@ class FedAvgAPI:
     def _train_rounds(
         self, packed, nsamples, comm_rounds, freq, ckpt, start_round
     ) -> Dict[str, float]:
+        if self.mode != "sequential" and not self._keep_stacked:
+            # the async executor (K rounds in flight, deferred metrics,
+            # shape-bucketed compile cache); pipeline_depth=1 (default)
+            # reproduces the synchronous loop's behavior and metrics
+            from ..core.round_pipeline import RoundPipeline
+
+            return RoundPipeline(self).run(
+                packed, nsamples, comm_rounds, freq, ckpt, start_round
+            )
+        return self._train_rounds_sync(
+            packed, nsamples, comm_rounds, freq, ckpt, start_round
+        )
+
+    def _train_rounds_sync(
+        self, packed, nsamples, comm_rounds, freq, ckpt, start_round
+    ) -> Dict[str, float]:
+        """Synchronous loop: the sequential (per-client python loop)
+        mode and the ``_keep_stacked`` algorithms, whose per-round host
+        hooks (Shapley scoring, secure-agg staging) need the stacked
+        cohort params on host every round."""
         args = self.args
         final_stats: Dict[str, float] = {}
         for round_idx in range(start_round, comm_rounds):
@@ -343,7 +386,7 @@ class FedAvgAPI:
             with self.profiler.span("round"):
                 if self.mode == "sequential":
                     new_global, summed = self._sequential_round(
-                        idx, round_rng, lr_mult
+                        idx, round_rng, lr_mult, nsamples=nsamples
                     )
                     self.global_params = new_global
                 else:
@@ -424,10 +467,16 @@ class FedAvgAPI:
             state["extra"] = extra
         ckpt.save(round_idx, state)
 
-    def _sequential_round(self, idx: np.ndarray, rng: jax.Array, lr_mult=None):
-        """Reference §3.1 shape: python loop over sampled clients."""
+    def _sequential_round(
+        self, idx: np.ndarray, rng: jax.Array, lr_mult=None, nsamples=None
+    ):
+        """Reference §3.1 shape: python loop over sampled clients.
+
+        Per-client work stays a device dispatch; sample counts are
+        gathered in ONE device op at round end from the ``nsamples``
+        array the caller already placed (the old per-client
+        ``float(...)`` forced a host round-trip inside the loop)."""
         stacked_leaves: List[Params] = []
-        ns: List[float] = []
         sums = None
         extra = () if lr_mult is None else (lr_mult,)
         for j, i in enumerate(idx):
@@ -440,12 +489,14 @@ class FedAvgAPI:
                 self.global_params, client, jax.random.fold_in(rng, j), *extra
             )
             stacked_leaves.append(p)
-            ns.append(float(self.dataset.packed_num_samples[i]))
             sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
         from ..core.aggregation import stack_pytrees
 
         stacked = stack_pytrees(stacked_leaves)
-        weights = normalize_weights(jnp.asarray(ns))
+        if nsamples is None:
+            nsamples = jnp.asarray(self.dataset.packed_num_samples)
+        ns = jnp.take(jnp.asarray(nsamples), jnp.asarray(idx))
+        weights = normalize_weights(ns)
         new_global, self.server_state = self._aggregate(
             self.global_params, self.server_state, stacked, weights, None, rng
         )

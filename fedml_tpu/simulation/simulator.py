@@ -11,6 +11,8 @@
 
 from __future__ import annotations
 
+import logging
+
 import jax
 
 from ..parallel.mesh import build_mesh, pad_federation, replicate, shard_federation
@@ -71,7 +73,18 @@ class SimulatorSingleProcess:
         from ..core.tracking import device_trace
 
         with device_trace(self.args):
-            return self.fl_trainer.train()
+            out = self.fl_trainer.train()
+        _log_pipeline_stats(self.fl_trainer)
+        return out
+
+
+def _log_pipeline_stats(fl_trainer) -> None:
+    """Surface the round-pipeline executor's run summary (depth, compile
+    bucket, host syncs/round) — the observability handle for tuning
+    ``pipeline_depth`` without attaching a profiler."""
+    stats = getattr(fl_trainer, "pipeline_stats", None)
+    if stats:
+        logging.info("round pipeline: %s", stats)
 
 
 class SimulatorMesh:
@@ -132,4 +145,6 @@ class SimulatorMesh:
         from ..core.tracking import device_trace
 
         with device_trace(self.args):
-            return self.fl_trainer.train()
+            out = self.fl_trainer.train()
+        _log_pipeline_stats(self.fl_trainer)
+        return out

@@ -168,6 +168,7 @@ GROUPS = [
         "client_num_per_round", "comm_round", "epochs", "batch_size",
         "client_optimizer", "learning_rate", "momentum", "weight_decay",
         "server_optimizer", "server_lr", "server_momentum", "fedprox_mu",
+        "sim_mode", "pipeline_depth", "pipeline_bucket",
     ]),
     ("LR schedule", [
         "lr_schedule", "lr_total_steps", "warmup_steps", "lr_total_rounds",

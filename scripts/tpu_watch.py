@@ -60,6 +60,7 @@ PHASES = [
     ("longctx", ["--phase", "longctx", "--tune"], 720.0),
     ("bf16", ["--phase", "bf16"], 300.0),
     ("headline", ["--phase", "headline"], 420.0),
+    ("pipeline", ["--phase", "pipeline"], 300.0),
     ("sweep_8", ["--phase", "sweep", "--cohort", "8"], 180.0),
     ("sweep_32", ["--phase", "sweep", "--cohort", "32"], 180.0),
     ("sweep_128", ["--phase", "sweep", "--cohort", "128"], 240.0),

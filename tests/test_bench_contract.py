@@ -50,6 +50,17 @@ class TestSchema:
         # priority (biggest last)
         assert bench._SWEEP_COHORTS == sorted(bench._SWEEP_COHORTS)
 
+    def test_pipeline_phase_contract(self):
+        """detail.pipeline ships rounds/s at K in {1,2,4}: the phase is
+        in the child vocabulary, the parent stitches it (like dense, it
+        runs demoted on the CPU fallback), and the K set is pinned."""
+        assert "pipeline" in bench.PHASE_CHOICES
+        assert bench._PIPELINE_KS == (1, 2, 4)
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"pipeline"' in parent or "'pipeline'" in parent
+
 
 class TestPhaseChild:
     def _run_child(self, phase: str, timeout: int) -> dict:
@@ -79,6 +90,35 @@ class TestPhaseChild:
         # tuning variants are TPU-only (--tune) — interpreter-mode
         # timings would mislead the block-size decision
         assert not any(k.startswith("flash_b") for k in d)
+
+    @pytest.mark.slow  # ~6.5s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's dedicated smoke block
+    def test_pipeline_smoke_child_writes_valid_json(self):
+        """The CI smoke invocation (K=2, 6 rounds, CPU): the executor
+        runs end-to-end and emits the detail.pipeline contract keys."""
+        with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
+            out = f.name
+        try:
+            r = subprocess.run(
+                [sys.executable, BENCH, "--phase", "pipeline", "--cpu",
+                 "--smoke", "--out", out],
+                capture_output=True, text=True, timeout=420, cwd=REPO,
+            )
+            assert r.returncode == 0, r.stderr[-800:]
+            with open(out) as fh:
+                d = json.load(fh)
+        finally:
+            os.unlink(out)
+        assert d["k2"]["rounds_per_sec"] > 0
+        assert d["k2"]["host_syncs_per_round"] is not None
+        assert d["rounds_timed"] == 6
+
+    @pytest.mark.slow  # subprocess + three full K-depth runs
+    def test_pipeline_cpu_child_reports_all_depths(self):
+        d = self._run_child("pipeline", 420)
+        for k in ("k1", "k2", "k4"):
+            assert d[k]["rounds_per_sec"] > 0, d
+        assert "speedup_k4_vs_k1" in d
 
     @pytest.mark.slow  # subprocess + 2-virtual-device mesh round
     def test_mesh_cpu_child_writes_valid_json(self):

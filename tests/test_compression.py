@@ -147,6 +147,7 @@ class TestCodecs:
 
 
 class TestCompressedFederation:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_int8_matches_uncompressed_closely(self, args_factory):
         """int8-compressed federation tracks the uncompressed one to
         quantization noise (same seeds/data/config)."""
@@ -162,6 +163,7 @@ class TestCompressedFederation:
                 np.asarray(a), np.asarray(b), atol=5e-3
             )
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_topk_error_feedback_learns(self, args_factory):
         """10%-sparsified uplink with error feedback still trains: the
         final global model beats the init loss on the server test set."""
@@ -221,6 +223,7 @@ class TestCompressedFederation:
 
 
 class TestCompressedHierarchical:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_hierarchical_int8_matches_horizontal_int8(self, args_factory):
         """The silo master inherits the compressed uplink: hierarchical
         (2 silos x 2-proc DP) with int8 == horizontal with int8."""

@@ -173,6 +173,7 @@ class TestCrossSiloLocal:
 
 
 class TestCrossSiloMqtt:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_mqtt_matches_local(self, args_factory):
         """Transport matrix completeness: the pub/sub broker backend
         produces the same global model as LOCAL (like gRPC and TRPC)."""

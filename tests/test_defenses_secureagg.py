@@ -198,6 +198,7 @@ class TestHSFedAvg:
         x2, _ = norm(x, mask, jnp.zeros((8, 8, 1)))
         np.testing.assert_array_equal(np.asarray(x2[2:]), np.asarray(x[2:]))
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_api_trains(self, args_factory):
         args = _small_args(args_factory, comm_round=2, model="cnn")
         dataset = load(args)
@@ -210,6 +211,7 @@ class TestHSFedAvg:
 
 
 class TestFedGAN:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_trains_and_reports(self, args_factory):
         args = _small_args(
             args_factory,

@@ -101,6 +101,10 @@ class TestMeshResolution:
             _resolve_mesh(_args(args_factory, mesh_shape={"dp": 4096}))
 
 
+# full tier only (re-tiered by measurement, round 6): each mode run
+# trains a transformer for multiple epochs — 20-110s apiece on a
+# 1-core box, far past the 4s fast-gate budget
+@pytest.mark.slow
 class TestModes:
     def test_dp_matches_single_device(self, args_factory):
         _, single = _run(args_factory, mesh_shape={"dp": 1})
@@ -326,6 +330,7 @@ class TestModes:
         assert stats["tokens_per_sec"] > 0
 
 
+@pytest.mark.slow
 class TestCheckpointResume:
     def test_resume_matches_uninterrupted(self, args_factory, tmp_path):
         """Train 2 epochs with checkpoints, 'crash', construct a fresh
@@ -378,6 +383,7 @@ class TestCheckpointResume:
         assert "test_acc" in again
 
 
+@pytest.mark.slow
 class TestOneLine:
     def test_run_distributed_entry(self, args_factory, monkeypatch):
         args = _args(args_factory, mesh_shape={"dp": 2})

@@ -13,6 +13,9 @@ import sys
 
 import pytest
 
+# full tier only: end-to-end example runs, minutes on a 1-core box
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 

@@ -135,6 +135,7 @@ class TestFaultInjectorUnit:
 
 
 class TestFaultsMeetFailureHandling:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_dropped_upload_recovered_by_deadline_cohort(self, args_factory):
         """One client's round-0 upload vanishes; with a deadline the
         server aggregates the 3 that arrived and the federation still
@@ -192,6 +193,7 @@ class TestFaultsMeetFailureHandling:
             patterns.append(pattern)
         assert patterns[0] != patterns[1]
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_all_uplinks_lost_recovered_by_rebroadcast(self, args_factory):
         """Correlated loss of EVERY round-0 upload: the deadline fires
         with zero uploads, the server rebroadcasts the round, clients
@@ -240,6 +242,7 @@ class TestFaultsMeetFailureHandling:
         )
         assert server.manager.round_idx == 0  # no round ever completed
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_duplicated_uploads_are_idempotent(self, args_factory):
         """At-least-once delivery: every upload sent twice must yield
         the SAME global model as exactly-once delivery."""

@@ -61,6 +61,7 @@ class TestDartsSpace:
 
 
 class TestFedNAS:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_search_round_improves_and_yields_genotype(self, args_factory):
         args = args_factory(
             dataset="cifar10",
@@ -103,6 +104,7 @@ class TestFedSeg:
         assert float(m["count"]) == 16.0  # one valid image x 16 pixels
         assert float(loss) == pytest.approx(np.log(3), rel=1e-5)
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_federated_segmentation_learns(self, args_factory):
         args = args_factory(
             dataset="pascal_voc",

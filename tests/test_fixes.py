@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from fedml_tpu.core.local_trainer import _shuffle_batches, make_local_train_fn
 from fedml_tpu.core.losses import softmax_cross_entropy, token_cross_entropy
@@ -92,6 +93,7 @@ class TestNWPLoss:
         assert float(m["count"]) == 2 * 7  # tokens of the 2 real examples
         np.testing.assert_allclose(float(loss), np.log(11), rtol=1e-5)
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_rnn_end_to_end(self, args_factory):
         """NWP pipeline: shakespeare-shaped synthetic + char RNN."""
         import fedml_tpu

@@ -215,6 +215,7 @@ class TestBf16Ring:
 
 
 class TestTransformerFL:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_transformer_federated_training(self, args_factory):
         from fedml_tpu import models
         from fedml_tpu.data import load

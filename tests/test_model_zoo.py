@@ -20,6 +20,7 @@ def _args(model: str, dataset: str = "cifar10") -> Arguments:
     "name",
     ["mobilenet", "mobilenet_v3", "vgg11", "vgg16", "efficientnet-b0"],
 )
+@pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
 def test_cv_models_forward(name):
     m = models.create(_args(name), 10)
     params = m.init(jax.random.PRNGKey(0))
@@ -71,6 +72,7 @@ def test_vfl_party_models():
     assert top.apply(tp, rep).shape == (4, 1)
 
 
+@pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
 def test_models_trainable_one_step():
     """One SGD step through the vectorized local trainer for a small
     zoo model — catches models whose forward isn't differentiable or

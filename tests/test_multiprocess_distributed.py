@@ -15,11 +15,17 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 import fedml_tpu
 from fedml_tpu import models
 from fedml_tpu.data import load
 from fedml_tpu.distributed import DistributedTrainer
+
+# full tier only: multiprocess collectives are unsupported by this
+# jaxlib's CPU backend, and the worlds are well over the 4s fast-gate
+# budget
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "dist_mp_worker.py")

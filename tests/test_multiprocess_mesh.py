@@ -17,6 +17,9 @@ import jax
 import numpy as np
 import pytest
 
+# full tier only: multiprocess collectives are unsupported by this jaxlib's CPU backend, and the worlds are well over the 4s fast-gate budget
+pytestmark = pytest.mark.slow
+
 import fedml_tpu
 from fedml_tpu import models
 from fedml_tpu.data import load

@@ -1,0 +1,323 @@
+"""Round-pipeline executor tests (core/round_pipeline.py).
+
+Covers the PR 2 acceptance contract:
+- sampling never clobbers the global NumPy RNG (and draws are identical
+  to the reference's ``np.random.seed(round_idx)`` contract);
+- a 10-round run traces the round fn exactly once; cohort-size changes
+  retrace at most once per power-of-two bucket, and the 8→512 sweep
+  needs at most ⌈log2(512/8)⌉+1 buckets;
+- K=4 produces bit-identical final params and metrics to K=1,
+  including checkpoint/restore mid-pipeline (drain before save);
+- the hot loop performs zero device fetches between metric flushes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+import fedml_tpu
+from fedml_tpu import models
+from fedml_tpu.core.round_pipeline import bucket_cohort, pad_cohort_idx
+from fedml_tpu.data import load
+from fedml_tpu.simulation import FedAvgAPI
+from fedml_tpu.simulation.fedavg_api import deterministic_client_sampling
+
+
+def _build(make, depth=1, **kw):
+    base = dict(
+        dataset="mnist",
+        synthetic_train_size=240,
+        synthetic_test_size=60,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=6,
+        client_num_per_round=4,
+        comm_round=5,
+        epochs=1,
+        batch_size=20,
+        learning_rate=0.1,
+        frequency_of_the_test=2,
+        shuffle=False,
+        pipeline_depth=depth,
+    )
+    base.update(kw)
+    args = make(**base)
+    args = fedml_tpu.init(args)
+    ds = load(args)
+    model = models.create(args, ds.class_num)
+    return args, ds, model, FedAvgAPI(args, None, ds, model)
+
+
+def _det_history(api):
+    """History minus wall-clock keys — the deterministic metric record."""
+    return [
+        {k: v for k, v in h.items() if k != "round_time_s"} for h in api.history
+    ]
+
+
+class TestSamplingRngHygiene:
+    def test_sampling_does_not_touch_global_rng(self):
+        np.random.seed(777)
+        before = np.random.get_state()
+        deterministic_client_sampling(3, 100, 10)
+        after = np.random.get_state()
+        assert before[0] == after[0]
+        assert np.array_equal(before[1], after[1])
+        assert before[2:] == after[2:]
+
+    def test_sampling_draws_match_reference_seed_contract(self):
+        """RandomState(round_idx) must reproduce np.random.seed(round_idx)
+        exactly (same MT19937 stream — FedAVGAggregator.py:99-113)."""
+        for r in (0, 1, 7, 42):
+            got = deterministic_client_sampling(r, 50, 8)
+            saved = np.random.get_state()
+            try:
+                np.random.seed(r)
+                want = np.asarray(
+                    np.random.choice(range(50), 8, replace=False), dtype=np.int32
+                )
+            finally:
+                np.random.set_state(saved)
+            assert np.array_equal(got, want)
+
+    def test_user_rng_state_survives_a_round(self, args_factory):
+        """Regression: training a round must not move the user's global
+        NumPy RNG (the old np.random.seed(round_idx) did)."""
+        _, _, _, api = _build(args_factory, comm_round=2)
+        np.random.seed(12345)
+        marker = np.random.get_state()
+        api.train()
+        assert np.array_equal(np.random.get_state()[1], marker[1])
+        # and the user's next draw is what it would have been
+        expected = np.random.RandomState(12345).random(4)
+        assert np.allclose(np.random.random(4), expected)
+
+
+class TestBucketing:
+    def test_pow2_buckets(self):
+        assert bucket_cohort(8) == 8
+        assert bucket_cohort(9) == 16
+        assert bucket_cohort(3) == 4
+        assert bucket_cohort(1) == 1
+
+    def test_bucket_capped_at_total_clients(self):
+        # a bucket can never exceed the federation: cap falls back to
+        # the exact size when the pow2 would overshoot the total
+        assert bucket_cohort(6, max_size=6) == 6
+        assert bucket_cohort(6, max_size=16) == 8
+
+    def test_bucket_respects_mesh_shard_multiple(self):
+        # pow2 incompatible with a 3-way clients axis -> exact size
+        assert bucket_cohort(6, shard_multiple=3) == 6
+        assert bucket_cohort(6, shard_multiple=2) == 8
+
+    def test_exact_policy_and_bad_policy(self):
+        assert bucket_cohort(6, policy="exact") == 6
+        with pytest.raises(ValueError, match="pipeline_bucket"):
+            bucket_cohort(6, policy="bogus")
+
+    def test_sweep_8_to_512_needs_at_most_7_buckets(self):
+        # acceptance: ⌈log2(512/8)⌉+1 = 7 round variants for the sweep
+        buckets = {bucket_cohort(c, max_size=512) for c in range(8, 513)}
+        assert buckets == {8, 16, 32, 64, 128, 256, 512}
+
+    def test_pad_cohort_idx(self):
+        idx, valid = pad_cohort_idx(np.array([5, 2, 9], dtype=np.int32), 4)
+        assert idx.tolist() == [5, 2, 9, 5]
+        assert valid.tolist() == [1.0, 1.0, 1.0, 0.0]
+        idx2, valid2 = pad_cohort_idx(np.array([1, 2], dtype=np.int32), 2)
+        assert idx2.tolist() == [1, 2] and valid2.tolist() == [1.0, 1.0]
+
+    def test_padded_bucket_matches_exact_cohort(self, args_factory):
+        """Padding invisibility: a 3-client cohort padded to bucket 4
+        trains to the same params as the exact-size run (shuffle off so
+        the per-client RNG count is the only split-shape difference)."""
+        results = {}
+        for policy in ("pow2", "exact"):
+            _, _, _, api = _build(
+                args_factory,
+                client_num_in_total=8,
+                client_num_per_round=3,
+                comm_round=3,
+                pipeline_bucket=policy,
+            )
+            api.train()
+            results[policy] = jax.tree.map(np.asarray, api.global_params)
+        assert api.pipeline_stats["bucket"] == 3  # exact run, sanity
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+            results["pow2"],
+            results["exact"],
+        )
+
+
+class TestCompileCount:
+    def test_ten_round_run_traces_once(self, args_factory):
+        _, _, _, api = _build(args_factory, comm_round=10, frequency_of_the_test=3)
+        api.train()
+        assert api._round_trace_count == 1
+
+    def test_cohort_changes_retrace_once_per_bucket(self, args_factory):
+        """Mid-run cohort-size changes hit the jit cache: cohorts
+        {3,4,6,8} share buckets {4,8} -> at most 2 traces."""
+        args, _, _, api = _build(
+            args_factory,
+            client_num_in_total=8,
+            client_num_per_round=3,
+            comm_round=2,
+        )
+        for c in (3, 4, 6, 8):
+            args.client_num_per_round = c
+            api.train()
+        assert api._round_trace_count == 2, api._round_trace_count
+
+
+class TestPipelineEquivalence:
+    def test_k4_bit_identical_to_k1(self, args_factory):
+        apis = {}
+        for depth in (1, 4):
+            _, _, _, api = _build(args_factory, depth=depth, comm_round=6)
+            api.train()
+            apis[depth] = api
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            apis[1].global_params,
+            apis[4].global_params,
+        )
+        assert _det_history(apis[1]) == _det_history(apis[4])
+        assert apis[4].pipeline_stats["depth"] == 4
+
+    def test_k4_with_lr_schedule_matches_k1(self, args_factory):
+        """The precomputed LR-multiplier plan must feed the round fn the
+        same per-round multipliers the synchronous loop would."""
+        apis = {}
+        for depth in (1, 4):
+            _, _, _, api = _build(
+                args_factory,
+                depth=depth,
+                comm_round=6,
+                lr_schedule="cosine",
+                lr_total_rounds=6,
+            )
+            api.train()
+            apis[depth] = api
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            apis[1].global_params,
+            apis[4].global_params,
+        )
+        assert _det_history(apis[1]) == _det_history(apis[4])
+
+    def test_checkpoint_restore_mid_pipeline(self, tmp_path, args_factory):
+        """K=4 run checkpointed at round 2 (pipeline drains before the
+        save), restored, and run to completion == uninterrupted K=1 run:
+        bit-identical params, identical metric history."""
+        d = str(tmp_path / "ck_pipe")
+
+        def run(depth, rounds, ckpt=True):
+            _, _, _, api = _build(args_factory, depth=depth, comm_round=rounds)
+            if ckpt:
+                api.args.checkpoint_dir = d
+                api.args.checkpoint_freq = 2
+            api.train()
+            return api
+
+        run(4, rounds=2)                      # interrupted mid-horizon
+        resumed = run(4, rounds=6)            # restores at round 2
+        straight = run(1, rounds=6, ckpt=False)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            resumed.global_params,
+            straight.global_params,
+        )
+        # resumed history covers rounds >= 2; the straight run's tail
+        # must match it exactly
+        resumed_hist = _det_history(resumed)
+        straight_tail = [
+            h for h in _det_history(straight)
+            if h["round"] >= resumed_hist[0]["round"]
+        ]
+        assert resumed_hist == straight_tail
+
+
+class TestZeroHostSyncHotLoop:
+    def test_no_device_fetch_between_flushes(self, args_factory, monkeypatch):
+        """Instrument device fetches: during a pipelined run every
+        device->host materialization must happen inside a deferred-
+        metrics flush — zero in the hot loop. Counts BOTH the explicit
+        ``jax.device_get`` path and implicit ``__array__``
+        materializations (``float(...)``, ``np.asarray(...)`` on device
+        arrays), so a reintroduced per-round host conversion cannot
+        slip past the explicit-path counter."""
+        from jax._src import array as jax_array
+
+        from fedml_tpu.core.tracking import DeferredMetrics
+
+        fetches = {"n": 0}
+        stray = {"n": 0}
+        in_flush = {"v": False}
+        real_get = jax.device_get
+
+        def counting_get(*a, **kw):
+            fetches["n"] += 1
+            return real_get(*a, **kw)
+
+        real_flush = DeferredMetrics.flush
+
+        def flagged_flush(self, upto=None):
+            in_flush["v"] = True
+            try:
+                return real_flush(self, upto)
+            finally:
+                in_flush["v"] = False
+
+        real_array = jax_array.ArrayImpl.__array__
+
+        def counting_array(self, *a, **kw):
+            if not in_flush["v"]:
+                stray["n"] += 1
+            return real_array(self, *a, **kw)
+
+        _, _, _, api = _build(
+            args_factory, depth=4, comm_round=8, frequency_of_the_test=2
+        )
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        monkeypatch.setattr(DeferredMetrics, "flush", flagged_flush)
+        monkeypatch.setattr(jax_array.ArrayImpl, "__array__", counting_array)
+        api.train()
+        stats = api.pipeline_stats
+        # every explicit fetch is a flush; no stray fetches in the hot
+        # loop, explicit or implicit
+        assert fetches["n"] == stats["flushes"] == stats["host_syncs"]
+        assert stray["n"] == 0, f"{stray['n']} device->host fetches outside flush"
+        # eval every 2 rounds over 8 rounds -> 5 records but fewer
+        # flushes than rounds; strictly below one sync per round
+        assert stats["host_syncs_per_round"] < 1.0
+        # all eval records still reach the history exactly once
+        assert [h["round"] for h in api.history] == [0, 2, 4, 6, 7]
+
+    def test_deferred_metrics_ring_contract(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.core.tracking import DeferredMetrics
+
+        ring = DeferredMetrics()
+        ring.push(0, {"a": jnp.float32(1.0)})
+        ring.push(2, {"a": jnp.float32(2.0)})
+        ring.push(4, {"a": jnp.float32(3.0)})
+        out = ring.flush(upto=2)
+        assert [r for r, _ in out] == [0, 2]
+        assert [float(t["a"]) for _, t in out] == [1.0, 2.0]
+        assert len(ring) == 1 and ring.host_syncs == 1
+        assert ring.flush(upto=1) == []       # nothing ready: no fetch
+        assert ring.host_syncs == 1
+        out = ring.flush(None)                # drain
+        assert [r for r, _ in out] == [4] and ring.host_syncs == 2

@@ -36,6 +36,7 @@ def _img_args(make, **kw):
 
 
 class TestSplitNN:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_loss_decreases_over_rounds(self, args_factory):
         args = _img_args(args_factory, comm_round=3)
         dataset = load(args)
@@ -45,6 +46,7 @@ class TestSplitNN:
         assert api.history[-1]["train_loss"] < api.history[0]["train_loss"]
         assert np.isfinite(api.history[-1]["test_acc"])
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_boundary_matches_joint_backprop(self, args_factory):
         """The vjp-seam gradient equals differentiating the composed
         network directly — the split changes WHERE grads are computed,
@@ -91,6 +93,7 @@ class TestSplitNN:
 
 
 class TestFedGKT:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_trains_and_improves(self, args_factory):
         args = _img_args(args_factory, comm_round=4, learning_rate=0.05)
         dataset = load(args)

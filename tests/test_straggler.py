@@ -82,6 +82,7 @@ def _run_world(args_factory, run_id, slow_rank=None, delay_s=0.0, **kw):
 
 
 class TestEvalOverlap:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_server_eval_overlaps_client_training(self, args_factory):
         """The server broadcasts the next round BEFORE evaluating the
         closed one, so clients train under the server's eval (the
@@ -140,6 +141,7 @@ class TestEvalOverlap:
 
 
 class TestDeadlineCohort:
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_straggler_dropped_rounds_complete(self, args_factory):
         # deadline must cover worst-case jit compile for the two fast
         # clients (fresh jit closures per world — there is no warm
@@ -170,6 +172,7 @@ class TestDeadlineCohort:
         assert server.manager.stragglers_dropped == 0
         assert wall >= 1.0  # blocked on the slow client (reference behavior)
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_deadline_result_matches_two_client_world(self, args_factory):
         """Dropping the straggler must equal a federation that never had
         it: aggregate(2 of 3) == aggregate over the same 2 clients."""

@@ -59,6 +59,7 @@ class TestProfiler:
         assert s["round"]["count"] == 2
         assert s["eval"]["count"] == 2
 
+    @pytest.mark.slow  # re-tiered by measurement (>4s fast-gate budget)
     def test_device_trace_captures_xplane(self, tmp_path, args_factory):
         """args.profile_dir -> a real XLA trace on disk (beyond the
         reference: SURVEY.md §5 'No torch-profiler integration')."""
@@ -166,6 +167,7 @@ class TestCheckpointResume:
         )
 
 
+@pytest.mark.slow  # re-tiered by measurement: spawned silo worlds, ~45s
 class TestCrossSiloCheckpointResume:
     """Server-side resume for the networked scenario: a cross-silo
     server killed mid-federation restarts from its checkpoint and the
