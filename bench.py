@@ -79,7 +79,8 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # its drift test) so a renamed phase can never silently burn tunnel
 # windows on rc!=0 children.
 PHASE_CHOICES = (
-    "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline"
+    "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
+    "telemetry",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -683,6 +684,48 @@ def run_mesh(on_cpu: bool) -> dict:
     return out
 
 
+def _pipeline_cohort(on_cpu: bool, smoke: bool):
+    """(n_rounds, cohort) shared by run_pipeline and run_telemetry —
+    both phases MUST measure the same cohorts or the telemetry-overhead
+    figure compares different work.
+
+    smoke: LR/MNIST-shape, the CI gate needs seconds, not a CNN
+    compile. on_cpu: small LR cohort — a CNN cohort x many rounds blows
+    past the phase window on a 1-core box."""
+    if smoke:
+        return 6, dict(
+            n_clients=4, epochs=1, per_client=50,
+            dataset="mnist", model="lr",
+        )
+    if on_cpu:
+        return 12, dict(
+            n_clients=8, epochs=1, per_client=100,
+            dataset="mnist", model="lr",
+        )
+    return 30, dict(n_clients=32, epochs=1, per_client=200)
+
+
+def _build_pipeline_api(n_rounds: int, cohort: dict, **overrides):
+    """Build + warm up the pipelined-cohort api (compiles round/eval
+    fns outside the clock) and set ``comm_round`` for the timed runs;
+    ONE api per phase so every timed ``train()`` reuses the jits — on a
+    TPU window that is one compile cycle, not one per run."""
+    extra = {k: v for k, v in cohort.items()
+             if k not in ("n_clients", "epochs", "per_client")}
+    extra.update(overrides)
+    args, _dataset, _model, api = _build_api(
+        cohort["n_clients"],
+        cohort["epochs"],
+        per_client=cohort["per_client"],
+        comm_round=1,
+        frequency_of_the_test=max(2, n_rounds // 3),
+        **extra,
+    )
+    api.train()  # warmup
+    args.comm_round = n_rounds
+    return args, api
+
+
 def run_pipeline(on_cpu: bool, smoke: bool = False) -> dict:
     """Round-pipeline phase: the async K-rounds-in-flight executor
     (core/round_pipeline.py) driven end-to-end through ``train()`` at
@@ -694,45 +737,14 @@ def run_pipeline(on_cpu: bool, smoke: bool = False) -> dict:
     plumbing in seconds; no cross-K comparison."""
     import jax
 
-    if smoke:
-        # LR/MNIST-shape: the CI gate needs seconds, not a CNN compile
-        ks, n_rounds = (2,), 6
-        cohort = dict(
-            n_clients=4, epochs=1, per_client=50,
-            dataset="mnist", model="lr",
-        )
-    elif on_cpu:
-        # demoted fallback: small LR cohort — a CNN x 3 depths x 12
-        # rounds blows past the phase window on a 1-core box, and the
-        # K-vs-K ratio (dispatch overlap) is what the phase measures
-        ks, n_rounds = _PIPELINE_KS, 12
-        cohort = dict(
-            n_clients=8, epochs=1, per_client=100,
-            dataset="mnist", model="lr",
-        )
-    else:
-        ks, n_rounds = _PIPELINE_KS, 30
-        cohort = dict(n_clients=32, epochs=1, per_client=200)
+    n_rounds, cohort = _pipeline_cohort(on_cpu, smoke)
+    ks = (2,) if smoke else _PIPELINE_KS
     out = {
         "cohort_clients": cohort["n_clients"],
         "rounds_timed": n_rounds,
         "device": str(jax.devices()[0]),
     }
-    extra = {k: v for k, v in cohort.items()
-             if k not in ("n_clients", "epochs", "per_client")}
-    # ONE api for every depth: pipeline_depth is host-side loop logic,
-    # so the round/eval jits compile once and all K runs reuse them —
-    # on a TPU window that's one compile cycle instead of three
-    args, dataset, _model, api = _build_api(
-        cohort["n_clients"],
-        cohort["epochs"],
-        per_client=cohort["per_client"],
-        comm_round=1,
-        frequency_of_the_test=max(2, n_rounds // 3),
-        **extra,
-    )
-    api.train()  # warmup: compiles round + eval fns outside the clock
-    args.comm_round = n_rounds
+    args, api = _build_pipeline_api(n_rounds, cohort)
     for k in ks:
         args.pipeline_depth = k
         t0 = time.perf_counter()
@@ -752,6 +764,69 @@ def run_pipeline(on_cpu: bool, smoke: bool = False) -> dict:
             / max(out["k1"]["rounds_per_sec"], 1e-9),
             3,
         )
+    return out
+
+
+def run_telemetry(on_cpu: bool, smoke: bool = False) -> dict:
+    """Telemetry-overhead phase: the pipelined cohort at depth 4 run
+    twice through ``train()`` — flight-recorder telemetry OFF then ON
+    (with trace.json export) — on the SAME jitted fns. Reports rounds/s
+    each way, the overhead percentage, and whether
+    ``host_syncs_per_round`` is bit-identical (the telemetry contract:
+    instruments are host-side only and never add a device fetch).
+
+    ``smoke`` (CI gate): 6 rounds on the LR/MNIST mini cohort."""
+    import tempfile
+
+    import jax
+
+    from fedml_tpu.core.telemetry import Telemetry
+
+    n_rounds, cohort = _pipeline_cohort(on_cpu, smoke)
+    args, api = _build_pipeline_api(n_rounds, cohort, pipeline_depth=4)
+    tdir = tempfile.mkdtemp(prefix="bench_telemetry_")
+    out = {
+        "cohort_clients": cohort["n_clients"],
+        "rounds_timed": n_rounds,
+        "pipeline_depth": 4,
+        "device": str(jax.devices()[0]),
+    }
+    try:
+        for mode in ("off", "on"):
+            Telemetry.reset()
+            api.telemetry = Telemetry.get_instance(args)
+            api.telemetry.enabled = mode == "on"
+            api.telemetry.attach_profiler(api.profiler)
+            # telemetry_dir stays unset during the clock: the timed
+            # window measures the INSTRUMENT overhead (the <2% claim),
+            # not the one-time trace/prom export I/O at run end
+            t0 = time.perf_counter()
+            api.train()
+            dt = time.perf_counter() - t0
+            out[mode] = {
+                "rounds_per_sec": round(n_rounds / dt, 4),
+                "host_syncs_per_round": api.pipeline_stats.get(
+                    "host_syncs_per_round"
+                ),
+            }
+            _progress(f"telemetry {mode}: {n_rounds / dt:.3f} rounds/s")
+        api.telemetry.export_run_artifacts(tdir)  # outside the clock
+        trace = os.path.join(tdir, "trace.json")
+        if os.path.exists(trace):
+            with open(trace) as fh:
+                out["trace_events"] = len(json.load(fh).get("traceEvents", []))
+    finally:
+        import shutil
+
+        shutil.rmtree(tdir, ignore_errors=True)
+    out["overhead_pct"] = round(
+        (out["off"]["rounds_per_sec"] - out["on"]["rounds_per_sec"])
+        / max(out["off"]["rounds_per_sec"], 1e-9) * 100,
+        2,
+    )
+    out["host_syncs_match"] = (
+        out["on"]["host_syncs_per_round"] == out["off"]["host_syncs_per_round"]
+    )
     return out
 
 
@@ -849,6 +924,9 @@ _DENSE_TIMEOUT_S = 170.0
 # one warmup compile + three timed train() runs (K=1/2/4) on the same
 # jitted fns; sized like the watcher's window for the first TPU compile
 _PIPELINE_TIMEOUT_S = 300.0
+# warmup compile + two timed train() runs (telemetry off/on) on the
+# same jitted fns
+_TELEMETRY_TIMEOUT_S = 240.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1070,59 +1148,46 @@ def _main_guarded() -> None:
         if note.startswith("timeout after"):
             wedge["suspect"] = True
 
+    def _run_demoted_phase(key: str, timeout_s: float) -> None:
+        """budget-gate -> tunnel-check -> isolated child for the phases
+        that run demoted (--cpu) when the tunnel is unusable, so
+        detail.<key> is always populated. remaining is recomputed AFTER
+        _tunnel_usable: the wedge probe may have spent up to
+        _WEDGE_PROBE_TIMEOUT_S, and the child window must fit what is
+        actually left — never floor past the budget."""
+        detail = result["detail"]
+        if _BUDGET_S - _elapsed() <= 60:
+            detail[f"{key}_skipped"] = "budget exhausted"
+            return
+        on_tpu = _tunnel_usable()
+        remaining = _BUDGET_S - _elapsed()
+        phase_args = ["--phase", key] + ([] if on_tpu else ["--cpu"])
+        out, note = (
+            (None, "budget exhausted after probe")
+            if remaining < 40
+            else _run_phase_subprocess(
+                phase_args, min(timeout_s, remaining - 10)
+            )
+        )
+        if out is not None:
+            if not on_tpu:
+                out["cpu_fallback"] = True
+            detail[key] = out
+        else:
+            _note_phase_outcome(note)
+            detail[f"{key}_skipped"] = note
+            _progress(f"{key} phase skipped ({note})")
+
     # compute-dense phase (ResNet-18/CIFAR-10, bf16): the MFU number
     # that matters. On TPU it runs the north-star cohort; on fallback a
     # demoted mini-cohort so the phase is still exercised.
-    if _BUDGET_S - _elapsed() > 60:
-        on_tpu = _tunnel_usable()
-        # recompute AFTER the gate: _tunnel_usable may have spent up to
-        # _WEDGE_PROBE_TIMEOUT_S probing, and the child window must fit
-        # what is actually left — never floor past the budget (same in
-        # every gate below)
-        remaining = _BUDGET_S - _elapsed()
-        dense_args = ["--phase", "dense"] + ([] if on_tpu else ["--cpu"])
-        dense, dnote = (
-            (None, "budget exhausted after probe")
-            if remaining < 40
-            else _run_phase_subprocess(
-                dense_args, min(_DENSE_TIMEOUT_S, remaining - 10)
-            )
-        )
-        if dense is not None:
-            if not on_tpu:
-                dense["cpu_fallback"] = True
-            result["detail"]["dense"] = dense
-        else:
-            _note_phase_outcome(dnote)
-            result["detail"]["dense_skipped"] = dnote
-            _progress(f"dense phase skipped ({dnote})")
-    else:
-        result["detail"]["dense_skipped"] = "budget exhausted"
-
-    # round-pipeline phase (K ∈ {1,2,4} rounds in flight): like dense it
-    # runs demoted on the CPU fallback so detail.pipeline is always
-    # populated — the K=4 vs K=1 ratio is the async executor's headline
-    if _BUDGET_S - _elapsed() > 60:
-        on_tpu = _tunnel_usable()
-        remaining = _BUDGET_S - _elapsed()
-        pipe_args = ["--phase", "pipeline"] + ([] if on_tpu else ["--cpu"])
-        pipe, pnote = (
-            (None, "budget exhausted after probe")
-            if remaining < 40
-            else _run_phase_subprocess(
-                pipe_args, min(_PIPELINE_TIMEOUT_S, remaining - 10)
-            )
-        )
-        if pipe is not None:
-            if not on_tpu:
-                pipe["cpu_fallback"] = True
-            result["detail"]["pipeline"] = pipe
-        else:
-            _note_phase_outcome(pnote)
-            result["detail"]["pipeline_skipped"] = pnote
-            _progress(f"pipeline phase skipped ({pnote})")
-    else:
-        result["detail"]["pipeline_skipped"] = "budget exhausted"
+    _run_demoted_phase("dense", _DENSE_TIMEOUT_S)
+    # round-pipeline phase (K ∈ {1,2,4} rounds in flight): the K=4 vs
+    # K=1 ratio is the async executor's headline
+    _run_demoted_phase("pipeline", _PIPELINE_TIMEOUT_S)
+    # telemetry-overhead phase (flight recorder on vs off at depth 4):
+    # the <2% claim and the host-syncs-identical contract as numbers
+    _run_demoted_phase("telemetry", _TELEMETRY_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -1258,6 +1323,8 @@ def _phase_main(argv) -> None:
         out = run_mesh(on_cpu=a.cpu)
     elif a.phase == "pipeline":
         out = run_pipeline(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "telemetry":
+        out = run_telemetry(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -17,3 +17,11 @@ python -m pytest tests/ -m "smoke and not slow" -q "$@"
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_pipeline_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Telemetry smoke (6 rounds, depth 4, flight recorder off vs on, CPU):
+# the detail.telemetry contract keys must ship and host_syncs_per_round
+# must be bit-identical with telemetry enabled — the "telemetry never
+# adds a device fetch" guarantee, end-to-end through the bench child.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_telemetry_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

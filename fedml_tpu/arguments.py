@@ -132,6 +132,21 @@ _DEFAULTS: Dict[str, Any] = {
     "mesh_shape": None,
     # capture an XLA device trace (tensorboard/perfetto) for the run
     "profile_dir": None,
+    # flight-recorder telemetry (core/telemetry.py): process-wide
+    # counters/gauges/histograms + Chrome-trace event ring. False
+    # disables every instrument (comm counting, pipeline events,
+    # watchdog); the hot loop is host-side either way
+    "telemetry": True,
+    # write run artifacts here: trace.json (perfetto-loadable merged
+    # timeline), metrics.prom (Prometheus text exposition),
+    # telemetry.jsonl (registry snapshots) and stall debug bundles.
+    # None = keep everything in-process only
+    "telemetry_dir": None,
+    # stall watchdog: if NO progress heartbeat (pipeline round, comm
+    # send/receive, cross-silo round) advances for this many seconds,
+    # dump a debug bundle (open spans, pending deferred metrics, last-N
+    # trace events, host+device sys_stats) to telemetry_dir. 0 disables
+    "stall_timeout_s": 0.0,
     # sequence-parallel strategy: "ring" or "ulysses"
     "sp_strategy": "ring",
     # ring attention: chunk each hop's K/V shard so the per-chip score
@@ -283,8 +298,14 @@ class Arguments:
             "partition_alpha",
             "fedprox_mu",
             "compression_topk_ratio",
+            "stall_timeout_s",
         ):
             setattr(self, float_key, float(getattr(self, float_key)))
+        if getattr(self, "stall_timeout_s", 0.0) < 0:
+            raise ValueError(
+                f"stall_timeout_s={self.stall_timeout_s}: must be >= 0 "
+                "(0 disables the stall watchdog)"
+            )
 
     # -- niceties ------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:

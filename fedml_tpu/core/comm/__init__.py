@@ -1,4 +1,5 @@
 """L1 communication backends (reference inventory: SURVEY.md §2.2)."""
 
 from .base import BaseCommunicationManager, Observer  # noqa: F401
+from .instrument import wrap_instrumented  # noqa: F401
 from .local import LocalCommunicationManager  # noqa: F401

@@ -76,6 +76,18 @@ class FaultInjector(BaseCommunicationManager):
         self.injected = {"drop": 0, "duplicate": 0, "delay": 0}
         self._timers = []
 
+    def _note_fault(self, kind: str, msg_type: int) -> None:
+        """Count the injection locally AND in the process-wide telemetry
+        registry (core/telemetry.py), so injected drops/delays stay
+        visible no matter how this wrapper is composed with the comm
+        instrumentation layer (core/comm/instrument.py)."""
+        self.injected[kind] += 1
+        from ..telemetry import Telemetry
+
+        Telemetry.get_instance().inc(
+            "comm_faults_injected_total", fault=kind, msg_type=int(msg_type)
+        )
+
     # -- fault decisions ----------------------------------------------
     def _armed(self, msg: Message) -> bool:
         if msg.get_sender_id() == msg.get_receiver_id():
@@ -94,14 +106,14 @@ class FaultInjector(BaseCommunicationManager):
         if self._armed(msg):
             roll = self._rng.random_sample()
             if roll < self.drop_prob:
-                self.injected["drop"] += 1
+                self._note_fault("drop", msg.get_type())
                 logging.warning(
                     "fault injection: DROP msg type %s %d->%d",
                     msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
                 )
                 return
             if roll < self.drop_prob + self.duplicate_prob:
-                self.injected["duplicate"] += 1
+                self._note_fault("duplicate", msg.get_type())
                 logging.warning(
                     "fault injection: DUPLICATE msg type %s %d->%d",
                     msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
@@ -110,7 +122,7 @@ class FaultInjector(BaseCommunicationManager):
                 self.inner.send_message(msg)
                 return
             if roll < self.drop_prob + self.duplicate_prob + self.delay_prob:
-                self.injected["delay"] += 1
+                self._note_fault("delay", msg.get_type())
                 logging.warning(
                     "fault injection: DELAY %.2fs msg type %s %d->%d",
                     self.delay_s, msg.get_type(),

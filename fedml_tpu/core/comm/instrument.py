@@ -1,0 +1,154 @@
+"""Comm-layer telemetry instrumentation for any transport.
+
+Same decorator pattern as ``faults.maybe_wrap_faulty``: wrap any
+``BaseCommunicationManager`` (local / grpc / mqtt / tensor_rpc) and
+count messages, payload bytes and send latency per message type into
+the process-wide ``Telemetry`` registry (``core/telemetry.py``), plus a
+flight-recorder instant per send so comm activity lands on the same
+perfetto timeline as compute spans.
+
+Counting semantics (see tests/test_telemetry.py):
+
+- sent counters record what THIS layer handed to its inner transport —
+  one count per wire send, never per wrapper layer, so stacking the
+  instrumented wrapper with ``FaultInjector`` in either order cannot
+  double-count bytes;
+- injected faults are counted by ``FaultInjector`` itself
+  (``comm_faults_injected_total``), so drops/delays are visible no
+  matter which wrapper is outermost;
+- received messages are counted by wrapping registered observers.
+
+Payload bytes are estimated from array/bytes leaf sizes (``nbytes`` is
+metadata — reading it never serializes the payload or touches the
+device), so instrumentation adds no host syncs and no double
+serialization on the zero-copy LOCAL fabric.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from .base import BaseCommunicationManager, Observer
+from ..message import Message
+
+
+def payload_nbytes(msg: Message) -> int:
+    """Approximate wire size of a message from leaf metadata only."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(msg.get_params()):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+        elif isinstance(leaf, (bytes, bytearray, str)):
+            total += len(leaf)
+        else:
+            total += 8  # scalar / small python object
+    return total
+
+
+class _CountingObserver(Observer):
+    def __init__(self, inner: Observer, telemetry) -> None:
+        self.inner = inner
+        self.telemetry = telemetry
+
+    def receive_message(self, msg_type: int, msg_params: Message) -> None:
+        self.telemetry.inc("comm_messages_received_total", msg_type=int(msg_type))
+        self.telemetry.heartbeat("comm.receive", int(msg_type))
+        self.inner.receive_message(msg_type, msg_params)
+
+
+class InstrumentedCommunicationManager(BaseCommunicationManager):
+    """Counts every send the inner transport performs; composes with
+    ``FaultInjector`` on either side (a delayed send fired from the
+    injector's timer thread is counted when it actually goes out —
+    the registry is thread-safe)."""
+
+    def __init__(self, inner: BaseCommunicationManager, telemetry) -> None:
+        self.inner = inner
+        self.telemetry = telemetry
+        self._observer_wrappers: Dict[Any, _CountingObserver] = {}
+
+    def send_message(self, msg: Message) -> None:
+        t = int(msg.get_type())
+        nbytes = payload_nbytes(msg)
+        t0 = time.perf_counter()
+        self.inner.send_message(msg)
+        dt = time.perf_counter() - t0
+        tel = self.telemetry
+        tel.inc("comm_messages_sent_total", msg_type=t)
+        tel.inc("comm_bytes_sent_total", nbytes, msg_type=t)
+        tel.observe("comm_send_latency_s", dt, msg_type=t)
+        tel.heartbeat("comm.send", t)
+        tel.recorder.instant(
+            "comm.send", cat="comm", msg_type=t, nbytes=nbytes,
+            sender=int(msg.get_sender_id()), receiver=int(msg.get_receiver_id()),
+        )
+
+    # -- observers (receive-side counting) ----------------------------
+    def add_observer(self, observer: Observer) -> None:
+        wrapper = _CountingObserver(observer, self.telemetry)
+        self._observer_wrappers[observer] = wrapper
+        self.inner.add_observer(wrapper)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self.inner.remove_observer(
+            self._observer_wrappers.pop(observer, observer)
+        )
+
+    # -- delegation ----------------------------------------------------
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()
+
+    def queue_depth(self):
+        """Inbox depth of the wrapped transport when it exposes one
+        (the LOCAL fabric's per-rank queue); None otherwise — sampled
+        into stall bundles via a telemetry probe."""
+        inner = self.inner
+        # unwrap other decorators (FaultInjector) down to the transport
+        for _ in range(4):
+            fabric = getattr(inner, "fabric", None)
+            if fabric is not None:
+                try:
+                    return fabric.inbox(int(inner.rank)).qsize()
+                except Exception:  # noqa: BLE001 — depth is best-effort
+                    return None
+            nxt = getattr(inner, "inner", None)
+            if nxt is None:
+                return None
+            inner = nxt
+        return None
+
+    def __getattr__(self, name):
+        # transports expose extras (destroy_fabric, ...); pass through
+        return getattr(self.inner, name)
+
+
+def wrap_instrumented(com: BaseCommunicationManager, args) -> BaseCommunicationManager:
+    """Wrap ``com`` with telemetry counting unless ``args.telemetry``
+    disables it. Also registers a queue-depth probe so the stall
+    watchdog's bundle can report comm backlog."""
+    from ..telemetry import Telemetry
+
+    import weakref
+
+    tel = Telemetry.get_instance(args)
+    if not tel.enabled or not bool(getattr(args, "telemetry", True)):
+        return com
+    inst = InstrumentedCommunicationManager(com, tel)
+    rank = int(getattr(args, "rank", 0) or 0)
+    # weakref: the probe lives in the process-wide registry and must
+    # not pin a torn-down comm stack (fabric queues, observers) alive
+    ref = weakref.ref(inst)
+
+    def _queue_probe():
+        i = ref()
+        return {"queue_depth": i.queue_depth() if i is not None else None}
+
+    tel.add_probe(f"comm_rank{rank}", _queue_probe)
+    return inst

@@ -115,10 +115,17 @@ class _ManagerBase(Observer):
             args, rank, size, backend
         )
         from .comm.faults import maybe_wrap_faulty
+        from .comm.instrument import wrap_instrumented
+        from .telemetry import Telemetry
 
-        # fault injection (core/comm/faults.py — beyond the reference):
-        # exercised per-process via args.fault_injection
-        self.com_manager = maybe_wrap_faulty(self.com_manager, args)
+        # telemetry counting sits INSIDE fault injection: the counters
+        # record actual wire traffic (a dropped message never left, a
+        # duplicated one left twice); injections themselves are counted
+        # by the FaultInjector (comm_faults_injected_total)
+        self.telemetry = Telemetry.get_instance(args)
+        self.com_manager = maybe_wrap_faulty(
+            wrap_instrumented(self.com_manager, args), args
+        )
         self.com_manager.add_observer(self)
         self.message_handler_dict: Dict[int, Callable[[Message], None]] = {}
 

@@ -197,6 +197,16 @@ class RoundPipeline:
             start_round, comm_rounds
         )
 
+        # telemetry (core/telemetry.py): every instrument below is a
+        # host-side counter bump / ring append — the hot loop gains no
+        # device fetches, so host_syncs_per_round is bit-identical with
+        # telemetry on or off (bench detail.telemetry asserts this)
+        tel = getattr(api, "telemetry", None)
+        tel = tel if tel is not None and tel.enabled else None
+        rec = tel.recorder if tel is not None else None
+        if tel is not None:
+            tel.attach_deferred(self.deferred)
+
         inflight: deque = deque()
         final_stats: Dict[str, float] = {}
         # per-round wall durations: dispatch-to-next-dispatch, finalized
@@ -210,7 +220,15 @@ class RoundPipeline:
 
         def flush(upto: Optional[int]) -> None:
             nonlocal final_stats
-            for r, host in self.deferred.flush(upto):
+            flushed = self.deferred.flush(upto)
+            if rec is not None and flushed:
+                rec.instant(
+                    "pipeline.flush" if upto is not None else "pipeline.drain",
+                    cat="pipeline",
+                    records=len(flushed),
+                    upto=upto,
+                )
+            for r, host in flushed:
                 t0r = t_dispatch.pop(r, None)
                 dt = durations.pop(r, None)
                 if dt is None and t0r is not None:
@@ -256,6 +274,10 @@ class RoundPipeline:
             inflight.append(summed["count"])
             while len(inflight) >= self.depth:
                 jax.block_until_ready(inflight.popleft())
+            if tel is not None:
+                tel.inc("pipeline_rounds_dispatched_total")
+                tel.heartbeat("pipeline.round", round_idx)
+                rec.instant("pipeline.dispatch", cat="pipeline", round=round_idx)
 
             if round_idx % freq == 0 or round_idx == comm_rounds - 1:
                 with api.profiler.span("eval"):
@@ -300,6 +322,13 @@ class RoundPipeline:
             ),
         }
         api.pipeline_stats = self.stats
+        if tel is not None:
+            tel.set_gauge("pipeline_depth", self.depth)
+            tel.set_gauge("pipeline_bucket", bucket)
+            tel.set_gauge(
+                "pipeline_host_syncs_per_round",
+                self.stats["host_syncs_per_round"],
+            )
         logging.debug("round pipeline: %s", self.stats)
         return final_stats
 

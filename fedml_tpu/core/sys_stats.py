@@ -41,30 +41,55 @@ def sample_host_stats() -> Dict[str, Any]:
     }
 
 
+# one debug line per process when a backend has no memory stats — not
+# one per 10s sampling tick
+_DEVICE_STATS_LOGGED = False
+
+
+def _log_device_stats_unavailable(why: str) -> None:
+    global _DEVICE_STATS_LOGGED
+    if not _DEVICE_STATS_LOGGED:
+        logging.debug("device memory stats unavailable: %s", why)
+        _DEVICE_STATS_LOGGED = True
+
+
 def sample_device_stats() -> Dict[str, Any]:
     """Accelerator memory stats from the JAX runtime (the GPU/pynvml
-    analog for TPU devices); empty when the backend has none."""
+    analog for TPU devices); empty when the backend has none.
+    ``bytes_limit`` is exported alongside ``bytes_in_use`` so HBM
+    headroom is a gauge, not a ratio the operator must reconstruct."""
     try:
         import jax
 
-        stats = {}
-        for i, dev in enumerate(jax.local_devices()):
-            ms = getattr(dev, "memory_stats", lambda: None)()
-            if ms:
-                stats[f"device{i}_bytes_in_use"] = ms.get("bytes_in_use", 0)
-                stats[f"device{i}_peak_bytes"] = ms.get("peak_bytes_in_use", 0)
-        return stats
-    except Exception:  # pragma: no cover - backend-specific
+        devices = jax.local_devices()
+    except (ImportError, RuntimeError) as e:  # backend init failed
+        _log_device_stats_unavailable(f"{type(e).__name__}: {e}")
         return {}
+    stats: Dict[str, Any] = {}
+    for i, dev in enumerate(devices):
+        try:
+            ms = getattr(dev, "memory_stats", lambda: None)()
+        except (RuntimeError, NotImplementedError, AttributeError) as e:
+            # the CPU backend (and some TPU runtimes) has no stats —
+            # expected, not an error worth hiding everything behind
+            _log_device_stats_unavailable(f"{dev}: {type(e).__name__}: {e}")
+            continue
+        if ms:
+            stats[f"device{i}_bytes_in_use"] = ms.get("bytes_in_use", 0)
+            stats[f"device{i}_peak_bytes"] = ms.get("peak_bytes_in_use", 0)
+            if "bytes_limit" in ms:
+                stats[f"device{i}_bytes_limit"] = ms["bytes_limit"]
+    return stats
 
 
 class SysStats:
     """Background sampler publishing to a reporter every ``interval_s``
     (system_stats.py's sampling loop, minus the wandb indirection)."""
 
-    def __init__(self, reporter, interval_s: float = 10.0) -> None:
+    def __init__(self, reporter, interval_s: float = 10.0, telemetry=None) -> None:
         self.reporter = reporter
         self.interval_s = float(interval_s)
+        self.telemetry = telemetry  # optional Telemetry: samples as gauges
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -80,6 +105,8 @@ class SysStats:
             try:
                 rec = {"kind": "sys_stats", **sample_host_stats(), **sample_device_stats()}
                 self.reporter.report(rec)
+                if self.telemetry is not None:
+                    self.telemetry.set_system_gauges(rec)
             except Exception:  # pragma: no cover
                 logging.exception("sys stats sampling failed")
 

@@ -49,21 +49,38 @@ class ProfilerEvent:
         self.spans: List[Dict[str, Any]] = []
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        # set by Telemetry.attach_profiler: spans are mirrored into the
+        # flight recorder's trace.json timeline (core/telemetry.py)
+        self.recorder = None
 
     @classmethod
     def get_instance(cls, args=None) -> "ProfilerEvent":
         if cls._instance is None:
             cls._instance = cls(args)
+        elif args is not None and cls._instance.args is None:
+            # a later caller finally supplied args: adopt them instead
+            # of silently ignoring them (the old singleton bug)
+            cls._instance.args = args
+            cls._instance.run_id = getattr(args, "run_id", "0")
         return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton so state cannot leak across tests."""
+        cls._instance = None
 
     def log_event_started(self, event_name: str, value: Any = None) -> None:
         self._open[event_name] = time.perf_counter()
+        if self.recorder is not None:
+            self.recorder.begin(event_name, cat="profiler")
 
     def log_event_ended(self, event_name: str, value: Any = None) -> None:
         t0 = self._open.pop(event_name, None)
         if t0 is None:
             logging.warning("span %r ended without start", event_name)
             return
+        if self.recorder is not None:
+            self.recorder.end(event_name, cat="profiler")
         dt = time.perf_counter() - t0
         self.spans.append(
             {"name": event_name, "duration_s": dt, "ended_at": time.time()}
@@ -162,14 +179,13 @@ class DeferredMetrics:
         self._pending.append((round_idx, device_tree))
 
     def flush(self, upto: Optional[int] = None):
-        ready = [
-            (r, t) for r, t in self._pending if upto is None or r <= upto
-        ]
+        ready: List[Any] = []
+        keep: List[Any] = []
+        for rec in self._pending:  # one pass, push order preserved
+            (ready if upto is None or rec[0] <= upto else keep).append(rec)
         if not ready:
             return []
-        self._pending = [
-            (r, t) for r, t in self._pending if not (upto is None or r <= upto)
-        ]
+        self._pending = keep
         import jax
 
         host = jax.device_get([t for _, t in ready])  # ONE fetch for all
@@ -236,7 +252,15 @@ class RunLogger:
     def get_instance(cls, args=None) -> "RunLogger":
         if cls._instance is None:
             cls._instance = cls(args)
+        elif args is not None and cls._instance.args is None:
+            # adopt late-supplied args instead of silently ignoring them
+            cls._instance.args = args
         return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton so state cannot leak across tests."""
+        cls._instance = None
 
     def init_logs(self, log_dir: Optional[str] = None) -> None:
         run_id = getattr(self.args, "run_id", "0") if self.args else "0"

@@ -109,6 +109,9 @@ class FedMLClientManager(ClientManager):
         # spans mirror the reference's instrumentation points
         # (client_master_manager.py:117-121: train / comm_c2s)
         self.profiler = ProfilerEvent(args)
+        # shared flight-recorder timeline + per-round progress marks
+        # for the stall watchdog (self.telemetry from _ManagerBase)
+        self.telemetry.attach_profiler(self.profiler)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -159,6 +162,14 @@ class FedMLClientManager(ClientManager):
         logging.info("client rank %d: finish", self.rank)
         self.finish()
 
+    def finish(self) -> None:
+        # client-side telemetry (spans, comm counters) must survive the
+        # process: rank-suffixed artifacts next to the server's
+        self.telemetry.export_run_artifacts(
+            getattr(self.args, "telemetry_dir", None)
+        )
+        super().finish()
+
     def _train_and_send(self, msg: Message) -> None:
         params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
@@ -166,6 +177,7 @@ class FedMLClientManager(ClientManager):
         self.trainer.update_dataset(client_index)
         with self.profiler.span("train"):
             new_params, n = self.trainer.train(params, round_idx)
+        self.telemetry.heartbeat(f"client{self.rank}.train", round_idx)
         out = Message(
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, self.server_rank
         )

@@ -94,6 +94,11 @@ class FedMLServerManager(ServerManager):
         # 71-74, :123-150: server.wait / aggregate spans + round info)
         self.profiler = ProfilerEvent(args)
         self.metrics_reporter = MetricsReporter(args, keep_history=False)
+        # flight recorder + stall surface (core/telemetry.py): spans on
+        # the shared timeline; round progress heartbeats for the
+        # watchdog (self.telemetry comes from _ManagerBase)
+        self.telemetry.attach_profiler(self.profiler)
+        self.telemetry.maybe_start_watchdog(args)
         self._wait_open = False
         self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
         self._deadline_timer = None
@@ -523,6 +528,13 @@ class FedMLServerManager(ServerManager):
                 "clients_aggregated": n_aggregated,
             }
         )
+        self.telemetry.heartbeat("cross_silo.round", round_idx)
+        self.telemetry.inc("cross_silo_rounds_total")
+        self.telemetry.inc("cross_silo_clients_aggregated_total", n_aggregated)
+        if self.stragglers_dropped:
+            self.telemetry.set_gauge(
+                "cross_silo_stragglers_dropped", self.stragglers_dropped
+            )
 
     def send_finish(self) -> None:
         for rank in range(1, len(self.client_real_ids) + 1):
@@ -530,3 +542,7 @@ class FedMLServerManager(ServerManager):
                 Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
             )
         logging.info("server: training finished after %d rounds", self.round_idx)
+        self.telemetry.stop_watchdog()
+        self.telemetry.export_run_artifacts(
+            getattr(self.args, "telemetry_dir", None)
+        )

@@ -189,12 +189,18 @@ class FedAvgAPI:
         self.server_state = self._init_server_state()
         self._build_jitted()
 
+        from ..core.telemetry import Telemetry
         from ..core.tracking import MetricsReporter, ProfilerEvent
 
         self.profiler = ProfilerEvent(args)
         # self.history is the round record of truth; the reporter only
         # fans out to sinks
         self.metrics_reporter = MetricsReporter(args, keep_history=False)
+        # process-wide registry + flight recorder (core/telemetry.py):
+        # profiler spans land on the trace.json timeline alongside the
+        # round pipeline's dispatch/flush/drain events
+        self.telemetry = Telemetry.get_instance(args)
+        self.telemetry.attach_profiler(self.profiler)
 
     # -- algorithm hooks ----------------------------------------------
     def _init_server_state(self):
@@ -243,6 +249,18 @@ class FedAvgAPI:
             lr_mult=1.0, valid=None,
         ):
             self._round_trace_count += 1
+            tel = getattr(self, "telemetry", None)
+            if tel is not None and tel.enabled:
+                # trace-time only (the python body runs when jit
+                # traces): counts EVERY trace, including the expected
+                # first compile of each shape bucket — healthy runs
+                # show one per bucket; more than that is a retrace
+                # storm, visible as a counter and timeline instants
+                # instead of silent compile stalls
+                tel.inc("pipeline_retraces_total")
+                tel.recorder.instant(
+                    "jit.retrace", cat="compile", bucket=int(idx.shape[0])
+                )
             cohort = _take(packed, idx)
             ns = jnp.take(nsamples, idx)
             if valid is not None:
@@ -330,6 +348,10 @@ class FedAvgAPI:
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
         ckpt, start_round = self._maybe_restore()
+        # stall watchdog (core/telemetry.py): armed only when
+        # args.stall_timeout_s > 0; observes the pipeline/comm
+        # heartbeats and dumps a debug bundle to args.telemetry_dir
+        watchdog = self.telemetry.maybe_start_watchdog(args)
         try:
             return self._train_rounds(
                 packed, nsamples, comm_rounds, freq, ckpt, start_round
@@ -337,6 +359,13 @@ class FedAvgAPI:
         finally:
             if ckpt is not None:
                 ckpt.close()
+            if watchdog is not None:
+                self.telemetry.stop_watchdog()
+            # one perfetto-loadable trace.json + registry exposition per
+            # run when args.telemetry_dir is set
+            self.telemetry.export_run_artifacts(
+                getattr(args, "telemetry_dir", None)
+            )
 
     def _lr_mult(self, round_idx: int):
         """Round-indexed LR multiplier (schedule(r) / peak), or None.

@@ -187,6 +187,7 @@ GROUPS = [
     ("Device", ["using_gpu", "device_type", "gpu_mapping_file"]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
+        "telemetry", "telemetry_dir", "stall_timeout_s",
     ]),
 ]
 

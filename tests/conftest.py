@@ -23,6 +23,20 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_observability_singletons():
+    """The tracking/telemetry singletons are process-wide; without a
+    reset, one test's args (or counters, heartbeats, watchdog) leak
+    into every later test in the worker."""
+    yield
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.core.tracking import ProfilerEvent, RunLogger
+
+    Telemetry.reset()
+    ProfilerEvent.reset()
+    RunLogger.reset()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

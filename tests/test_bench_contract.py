@@ -61,18 +61,30 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"pipeline"' in parent or "'pipeline'" in parent
 
+    def test_telemetry_phase_contract(self):
+        """detail.telemetry ships the flight-recorder overhead figures:
+        the phase is in the child vocabulary and the parent stitches it
+        (like pipeline, it runs demoted on the CPU fallback)."""
+        assert "telemetry" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"telemetry"' in parent or "'telemetry'" in parent
+
 
 class TestPhaseChild:
-    def _run_child(self, phase: str, timeout: int) -> dict:
+    def _run_child(self, phase: str, timeout: int, smoke: bool = False) -> dict:
         """Invoke one --cpu phase child exactly as the parent/watcher
         do and return its JSON — ONE copy of the invocation contract,
         so a changed flag or env requirement breaks every phase test."""
         with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
             out = f.name
+        cmd = [sys.executable, BENCH, "--phase", phase, "--cpu"]
+        if smoke:
+            cmd.append("--smoke")
         try:
             r = subprocess.run(
-                [sys.executable, BENCH, "--phase", phase, "--cpu",
-                 "--out", out],
+                cmd + ["--out", out],
                 capture_output=True, text=True, timeout=timeout, cwd=REPO,
             )
             assert r.returncode == 0, r.stderr[-800:]
@@ -96,19 +108,7 @@ class TestPhaseChild:
     def test_pipeline_smoke_child_writes_valid_json(self):
         """The CI smoke invocation (K=2, 6 rounds, CPU): the executor
         runs end-to-end and emits the detail.pipeline contract keys."""
-        with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
-            out = f.name
-        try:
-            r = subprocess.run(
-                [sys.executable, BENCH, "--phase", "pipeline", "--cpu",
-                 "--smoke", "--out", out],
-                capture_output=True, text=True, timeout=420, cwd=REPO,
-            )
-            assert r.returncode == 0, r.stderr[-800:]
-            with open(out) as fh:
-                d = json.load(fh)
-        finally:
-            os.unlink(out)
+        d = self._run_child("pipeline", 420, smoke=True)
         assert d["k2"]["rounds_per_sec"] > 0
         assert d["k2"]["host_syncs_per_round"] is not None
         assert d["rounds_timed"] == 6
@@ -119,6 +119,23 @@ class TestPhaseChild:
         for k in ("k1", "k2", "k4"):
             assert d[k]["rounds_per_sec"] > 0, d
         assert "speedup_k4_vs_k1" in d
+
+    @pytest.mark.slow  # ~10s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's telemetry smoke block
+    def test_telemetry_smoke_child_writes_valid_json(self):
+        """The CI telemetry smoke invocation (6 rounds, depth 4, CPU):
+        the flight recorder runs end-to-end through bench.py's
+        telemetry phase child and emits the detail.telemetry contract
+        keys — both timings, the overhead figure, the host-sync
+        bit-identity flag, and a non-empty exported trace."""
+        d = self._run_child("telemetry", 420, smoke=True)
+        assert d["rounds_timed"] == 6 and d["pipeline_depth"] == 4
+        for mode in ("off", "on"):
+            assert d[mode]["rounds_per_sec"] > 0
+            assert d[mode]["host_syncs_per_round"] is not None
+        assert "overhead_pct" in d
+        assert d["host_syncs_match"] is True
+        assert d["trace_events"] > 0
 
     @pytest.mark.slow  # subprocess + 2-virtual-device mesh round
     def test_mesh_cpu_child_writes_valid_json(self):

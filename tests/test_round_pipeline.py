@@ -295,7 +295,8 @@ class TestZeroHostSyncHotLoop:
         api.train()
         stats = api.pipeline_stats
         # every explicit fetch is a flush; no stray fetches in the hot
-        # loop, explicit or implicit
+        # loop, explicit or implicit — and one device fetch per
+        # non-empty flush (a second fetch inside flush() breaks this)
         assert fetches["n"] == stats["flushes"] == stats["host_syncs"]
         assert stray["n"] == 0, f"{stray['n']} device->host fetches outside flush"
         # eval every 2 rounds over 8 rounds -> 5 records but fewer
@@ -321,3 +322,4 @@ class TestZeroHostSyncHotLoop:
         assert ring.host_syncs == 1
         out = ring.flush(None)                # drain
         assert [r for r, _ in out] == [4] and ring.host_syncs == 2
+        assert ring.host_syncs == ring.flushes  # one fetch per flush

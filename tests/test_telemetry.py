@@ -1,0 +1,576 @@
+"""Flight-recorder telemetry (core/telemetry.py, core/comm/instrument.py).
+
+Covers the PR 3 acceptance contract:
+- registry primitives (tagged counters/gauges/histograms), Prometheus
+  text exposition, snapshots through the MetricsReporter sink seam;
+- singleton hygiene: reset() + late-args adoption for Telemetry,
+  ProfilerEvent and RunLogger;
+- trace.json schema: valid Chrome trace event JSON, monotonic ts,
+  matched B/E pairs — from both the unit recorder and a real pipelined
+  train() run;
+- comm instrumentation composed with FaultInjector in BOTH wrap
+  orders: injected drops/delays appear in counters, bytes are never
+  double-counted;
+- the hot-loop contract: host_syncs_per_round is bit-identical with
+  telemetry on and off;
+- a forced stall produces a debug bundle with open spans, the pending
+  deferred-metric count, and a host+device sys_stats snapshot.
+"""
+
+import json
+import os
+import re
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from fedml_tpu.core.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.core.comm.faults import FaultInjector
+from fedml_tpu.core.comm.instrument import (
+    InstrumentedCommunicationManager,
+    payload_nbytes,
+    wrap_instrumented,
+)
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.telemetry import FlightRecorder, Telemetry
+from fedml_tpu.core.tracking import DeferredMetrics, ProfilerEvent, RunLogger
+
+from test_round_pipeline import _build
+
+
+class _FakeTransport(BaseCommunicationManager):
+    """Records sends and can deliver inbound messages to observers."""
+
+    def __init__(self):
+        self.sent = []
+        self.observers = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def add_observer(self, o):
+        self.observers.append(o)
+
+    def remove_observer(self, o):
+        self.observers.remove(o)
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+    def deliver(self, msg):
+        for o in self.observers:
+            o.receive_message(msg.get_type(), msg)
+
+
+def _msg(t=3, payload=None, sender=1, receiver=0):
+    m = Message(t, sender, receiver)
+    if payload is not None:
+        m.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+    return m
+
+
+class TestRegistry:
+    def test_counters_gauges_histograms_tagged(self):
+        tel = Telemetry.get_instance()
+        tel.inc("msgs_total", msg_type=3)
+        tel.inc("msgs_total", 2, msg_type=3)
+        tel.inc("msgs_total", msg_type=5)
+        tel.set_gauge("depth", 4)
+        tel.observe("lat_s", 0.5)
+        tel.observe("lat_s", 1.5)
+        assert tel.get_counter("msgs_total", msg_type=3) == 3
+        assert tel.get_counter("msgs_total", msg_type=5) == 1
+        snap = tel.snapshot()
+        assert snap["counters"]["msgs_total{msg_type=3}"] == 3
+        assert snap["gauges"]["depth"] == 4
+        h = snap["histograms"]["lat_s"]
+        assert h["count"] == 2 and h["sum"] == 2.0
+        assert h["min"] == 0.5 and h["max"] == 1.5
+
+    def test_disabled_registry_is_inert(self):
+        tel = Telemetry.get_instance()
+        tel.enabled = False
+        tel.inc("n")
+        tel.heartbeat("hb")
+        tel.recorder.instant("x")
+        assert tel.get_counter("n") == 0
+        assert tel.heartbeats() == {}
+        assert len(tel.recorder) == 0
+
+    def test_prometheus_text_exposition(self, args_factory):
+        args = args_factory(run_id="promrun")
+        args.rank = 2
+        tel = Telemetry.get_instance(args)
+        tel.inc("comm_messages_sent_total", 7, msg_type=3)
+        tel.set_gauge("pipeline_depth", 4)
+        tel.observe("comm_send_latency_s", 0.25, msg_type=3)
+        text = tel.prometheus_text()
+        assert "# TYPE comm_messages_sent_total counter" in text
+        assert re.search(
+            r'comm_messages_sent_total\{[^}]*msg_type="3"[^}]*\} 7\.0', text
+        )
+        assert 'run_id="promrun"' in text and 'rank="2"' in text
+        assert "comm_send_latency_s_count" in text
+        assert "comm_send_latency_s_sum" in text
+        # every sample line is NAME{LABELS} VALUE
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert re.fullmatch(
+                r"[a-zA-Z_:][a-zA-Z0-9_:]*\{[^}]*\} [-0-9.e+]+", line
+            ), line
+
+    def test_prometheus_label_values_escaped(self, args_factory):
+        # a quote/backslash/newline in a tag value must not corrupt
+        # the exposition
+        args = args_factory(run_id='exp"A')
+        tel = Telemetry.get_instance(args)
+        tel.inc("x_total", path="a\\b\nc")
+        text = tel.prometheus_text()
+        assert 'run_id="exp\\"A"' in text
+        assert 'path="a\\\\b\\nc"' in text
+
+    def test_snapshot_through_metricsreporter_sink_seam(self, tmp_path):
+        tel = Telemetry.get_instance()
+        tel.inc("x_total")
+        got = []
+        tel.add_sink(got.append)
+        path = str(tmp_path / "tel.jsonl")
+        tel.add_jsonl_sink(path)
+        tel.publish_snapshot()
+        assert got and got[0]["kind"] == "telemetry_snapshot"
+        rec = json.loads(open(path).read().strip())
+        assert rec["counters"]["x_total"] == 1
+
+    def test_singleton_reset_and_late_args_adoption(self, args_factory):
+        # late args no longer silently ignored by any of the singletons
+        tel = Telemetry.get_instance()
+        assert tel.run_id == "0"
+        args = args_factory(run_id="later")
+        assert Telemetry.get_instance(args) is tel
+        assert tel.run_id == "later"
+        Telemetry.reset()
+        assert Telemetry.get_instance() is not tel
+
+        pe = ProfilerEvent.get_instance()
+        assert ProfilerEvent.get_instance(args).run_id == "later"
+        ProfilerEvent.reset()
+        assert ProfilerEvent.get_instance() is not pe
+
+        rl = RunLogger.get_instance()
+        assert RunLogger.get_instance(args).args is args
+        RunLogger.reset()
+        assert RunLogger.get_instance() is not rl
+
+
+def _check_trace_schema(payload):
+    """Valid Chrome trace JSON: known phases, monotonic ts, matched
+    B/E pairs per (tid, name)."""
+    evs = payload["traceEvents"]
+    assert evs, "empty trace"
+    for ev in evs:
+        assert ev["ph"] in ("B", "E", "i", "C"), ev
+        for key in ("name", "cat", "ts", "pid", "tid"):
+            assert key in ev, ev
+    assert all(
+        evs[i]["ts"] <= evs[i + 1]["ts"] for i in range(len(evs) - 1)
+    ), "timestamps not monotonic"
+    depth = {}
+    for ev in evs:
+        k = (ev["tid"], ev["name"])
+        if ev["ph"] == "B":
+            depth[k] = depth.get(k, 0) + 1
+        elif ev["ph"] == "E":
+            depth[k] = depth.get(k, 0) - 1
+            assert depth[k] >= 0, f"E without B: {k}"
+    assert all(d == 0 for d in depth.values()), f"unmatched B/E: {depth}"
+    return evs
+
+
+class TestFlightRecorder:
+    def test_export_schema_and_pairing(self, tmp_path):
+        rec = FlightRecorder()
+        rec.begin("round", round=0)
+        rec.instant("pipeline.dispatch", round=0)
+        rec.end("round")
+        rec.counter("inflight", 2)
+        rec.end("never_began")  # orphan E: must be dropped at export
+        rec.begin("left_open")  # must be force-closed at export
+        path = rec.export(str(tmp_path / "trace.json"), meta={"run_id": "t"})
+        payload = json.load(open(path))
+        evs = _check_trace_schema(payload)
+        names = [e["name"] for e in evs]
+        assert "round" in names and "pipeline.dispatch" in names
+        assert "never_began" not in names  # orphan E dropped entirely
+        closer = [e for e in evs if e.get("args", {}).get("forced_close")]
+        assert len(closer) == 1 and closer[0]["name"] == "left_open"
+        assert payload["otherData"]["run_id"] == "t"
+
+    def test_profiler_spans_land_in_recorder(self):
+        tel = Telemetry.get_instance()
+        prof = ProfilerEvent()
+        tel.attach_profiler(prof)
+        with prof.span("train"):
+            pass
+        phases = [(e["name"], e["ph"]) for e in tel.recorder.tail()]
+        assert ("train", "B") in phases and ("train", "E") in phases
+
+
+class TestCommInstrumentation:
+    def test_send_receive_counters_bytes_latency(self):
+        tel = Telemetry.get_instance()
+        rec = _FakeTransport()
+        inst = InstrumentedCommunicationManager(rec, tel)
+        payload = {"w": np.zeros((10, 4), dtype=np.float32)}
+        m = _msg(3, payload)
+        nb = payload_nbytes(m)
+        assert nb >= 160  # the array alone
+        inst.send_message(m)
+        inst.send_message(_msg(5))
+        assert len(rec.sent) == 2
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 1
+        assert tel.get_counter("comm_bytes_sent_total", msg_type=3) == nb
+        lat = tel.snapshot()["histograms"]["comm_send_latency_s{msg_type=3}"]
+        assert lat["count"] == 1
+
+        class _Obs(Observer):
+            def __init__(self):
+                self.got = []
+
+            def receive_message(self, t, m):
+                self.got.append(t)
+
+        obs = _Obs()
+        inst.add_observer(obs)
+        rec.deliver(_msg(3))
+        assert obs.got == [3]
+        assert tel.get_counter("comm_messages_received_total", msg_type=3) == 1
+        inst.remove_observer(obs)
+        assert rec.observers == []
+
+    def test_send_lands_on_trace_timeline(self):
+        tel = Telemetry.get_instance()
+        inst = InstrumentedCommunicationManager(_FakeTransport(), tel)
+        inst.send_message(_msg(3))
+        evs = [e for e in tel.recorder.tail() if e["name"] == "comm.send"]
+        assert evs and evs[0]["args"]["msg_type"] == 3
+
+    def test_queue_depth_probe_on_local_fabric(self, args_factory):
+        from fedml_tpu.core.comm.local import LocalCommunicationManager
+
+        com = LocalCommunicationManager("tel_qd_fab", rank=0, size=2)
+        inst = wrap_instrumented(com, args_factory())
+        assert isinstance(inst, InstrumentedCommunicationManager)
+        assert inst.queue_depth() == 0
+        inst.send_message(_msg(3, receiver=0))
+        assert inst.queue_depth() == 1
+        com.destroy_fabric()
+
+    def test_wrap_disabled_returns_untouched(self, args_factory):
+        args = args_factory(telemetry=False)
+        com = _FakeTransport()
+        assert wrap_instrumented(com, args) is com
+
+
+class TestFaultInjectorComposition:
+    """Both wrap orders: injections visible in counters, bytes never
+    double-counted. Sent counters mean ACTUAL wire sends (the managers
+    stack instrumentation inside fault injection)."""
+
+    def _fresh(self, args_factory):
+        Telemetry.reset()
+        return Telemetry.get_instance(args_factory()), _FakeTransport()
+
+    def test_drop_instrumented_inner(self, args_factory):
+        tel, rec = self._fresh(args_factory)
+        com = FaultInjector(
+            InstrumentedCommunicationManager(rec, tel), drop_prob=1.0
+        )
+        m = _msg(3, {"w": np.ones((8,), np.float32)})
+        com.send_message(m)
+        assert rec.sent == []  # dropped before the wire
+        assert tel.get_counter(
+            "comm_faults_injected_total", fault="drop", msg_type=3
+        ) == 1
+        # a dropped message never left this process: zero wire bytes
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 0
+        assert tel.get_counter("comm_bytes_sent_total", msg_type=3) == 0
+
+    def test_drop_instrumented_outer(self, args_factory):
+        tel, rec = self._fresh(args_factory)
+        com = InstrumentedCommunicationManager(
+            FaultInjector(rec, drop_prob=1.0), tel
+        )
+        m = _msg(3, {"w": np.ones((8,), np.float32)})
+        nb = payload_nbytes(m)
+        com.send_message(m)
+        assert rec.sent == []
+        assert tel.get_counter(
+            "comm_faults_injected_total", fault="drop", msg_type=3
+        ) == 1
+        # outer layer counts the attempt exactly once — never twice
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 1
+        assert tel.get_counter("comm_bytes_sent_total", msg_type=3) == nb
+
+    def test_duplicate_counts_each_wire_send_once(self, args_factory):
+        tel, rec = self._fresh(args_factory)
+        com = FaultInjector(
+            InstrumentedCommunicationManager(rec, tel),
+            duplicate_prob=1.0, max_faults=1,
+        )
+        m = _msg(3, {"w": np.ones((8,), np.float32)})
+        nb = payload_nbytes(m)
+        com.send_message(m)
+        assert len(rec.sent) == 2  # at-least-once delivery
+        assert tel.get_counter(
+            "comm_faults_injected_total", fault="duplicate", msg_type=3
+        ) == 1
+        # two wire sends -> exactly 2x bytes, one count per send, no
+        # per-layer double count on top
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 2
+        assert tel.get_counter("comm_bytes_sent_total", msg_type=3) == 2 * nb
+
+    def test_delay_counted_when_it_actually_sends(self, args_factory):
+        tel, rec = self._fresh(args_factory)
+        com = FaultInjector(
+            InstrumentedCommunicationManager(rec, tel),
+            delay_prob=1.0, delay_s=0.05, max_faults=1,
+        )
+        com.send_message(_msg(3))
+        assert tel.get_counter(
+            "comm_faults_injected_total", fault="delay", msg_type=3
+        ) == 1
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 0
+        deadline = time.time() + 2
+        while time.time() < deadline and not rec.sent:
+            time.sleep(0.01)
+        assert len(rec.sent) == 1
+        assert tel.get_counter("comm_messages_sent_total", msg_type=3) == 1
+
+
+class TestPipelineTraceExport:
+    def test_train_writes_valid_trace_json(self, tmp_path, args_factory):
+        """A pipelined run with telemetry_dir set leaves a perfetto-
+        loadable trace.json carrying profiler spans AND pipeline
+        events on one timeline (the CI schema gate)."""
+        tdir = str(tmp_path / "tel")
+        _, _, _, api = _build(
+            args_factory, depth=2, comm_round=4, telemetry_dir=tdir
+        )
+        api.train()
+        payload = json.load(open(os.path.join(tdir, "trace.json")))
+        evs = _check_trace_schema(payload)
+        names = {e["name"] for e in evs}
+        assert "round" in names  # profiler span (B/E pair)
+        assert "pipeline.dispatch" in names  # pipeline instant
+        assert "pipeline.flush" in names or "pipeline.drain" in names
+        # registry exposition rides along
+        assert os.path.exists(os.path.join(tdir, "metrics.prom"))
+        assert os.path.exists(os.path.join(tdir, "telemetry.jsonl"))
+        assert api.telemetry.get_counter("pipeline_rounds_dispatched_total") == 4
+
+    def test_nonzero_rank_exports_suffixed_files(self, tmp_path, args_factory):
+        """Ranks sharing one telemetry_dir must not clobber each other:
+        non-zero ranks write trace_rankN.json / metrics_rankN.prom."""
+        args = args_factory()
+        args.rank = 2
+        tel = Telemetry.get_instance(args)
+        tel.inc("x_total")
+        tel.export_run_artifacts(str(tmp_path))
+        assert (tmp_path / "trace_rank2.json").exists()
+        assert (tmp_path / "metrics_rank2.prom").exists()
+        assert not (tmp_path / "trace.json").exists()
+
+    def test_host_syncs_identical_telemetry_on_vs_off(self, args_factory):
+        """The hot-loop contract: telemetry never adds a device fetch,
+        so host_syncs_per_round is bit-identical on vs off."""
+        stats = {}
+        for enabled in (True, False):
+            Telemetry.reset()
+            _, _, _, api = _build(
+                args_factory, depth=4, comm_round=8,
+                frequency_of_the_test=2, telemetry=enabled,
+            )
+            api.train()
+            stats[enabled] = api.pipeline_stats
+        assert (
+            stats[True]["host_syncs_per_round"]
+            == stats[False]["host_syncs_per_round"]
+        )
+        assert stats[True]["host_syncs"] == stats[False]["host_syncs"]
+
+    def test_retrace_storm_is_visible(self, args_factory):
+        """Every jit retrace lands as a counter + a timeline instant
+        with the cohort bucket."""
+        args, _, _, api = _build(args_factory, comm_round=2)
+        api.train()
+        tel = api.telemetry
+        assert tel.get_counter("pipeline_retraces_total") == 1
+        args.client_num_per_round = 6  # bucket 6 (pow2 capped): retrace
+        api.train()
+        assert tel.get_counter("pipeline_retraces_total") == 2
+        buckets = [
+            e["args"]["bucket"] for e in tel.recorder.tail()
+            if e["name"] == "jit.retrace"
+        ]
+        assert buckets == [4, 6]
+
+
+class TestStallWatchdog:
+    def test_forced_stall_dumps_debug_bundle(self, tmp_path, args_factory):
+        """Acceptance: a forced stall produces a bundle containing open
+        spans, the pending-metric count, and a host+device stats
+        snapshot — and fires once per stall episode, not per poll."""
+        tdir = str(tmp_path / "bundles")
+        args = args_factory(stall_timeout_s=0.3, telemetry_dir=tdir)
+        tel = Telemetry.get_instance(args)
+        prof = ProfilerEvent(args)
+        tel.attach_profiler(prof)
+        prof.log_event_started("train")  # a span left open = the hang
+        ring = DeferredMetrics()
+        ring.push(7, {"loss": jnp.float32(1.0)})
+        tel.attach_deferred(ring)
+        tel.add_probe("comm_rank0", lambda: {"queue_depth": 5})
+        wd = tel.maybe_start_watchdog(args)
+        assert wd is not None
+        tel.heartbeat("pipeline.round", 17)  # ...then progress stops
+        deadline = time.time() + 10
+        while time.time() < deadline and not wd.bundles:
+            time.sleep(0.05)
+        assert wd.bundles, "watchdog never fired"
+        bundle = json.load(open(wd.bundles[0]))
+        assert bundle["kind"] == "stall_bundle"
+        assert bundle["heartbeats"]["pipeline.round"]["value"] == 17
+        assert [s["name"] for s in bundle["open_spans"]] == ["train"]
+        assert bundle["pending_deferred_metrics"] == 1
+        assert "host_stats" in bundle and "device_stats" in bundle
+        assert bundle["probes"]["comm_rank0"] == {"queue_depth": 5}
+        # one bundle per episode: still stalled, but no second dump
+        time.sleep(0.5)
+        assert len(wd.bundles) == 1
+        tel.stop_watchdog()
+
+    def test_stale_marks_get_grace_but_first_heartbeat_hang_fires(
+        self, tmp_path, args_factory
+    ):
+        """The singleton outlives train() calls: marks left by a
+        finished run must not read as an INSTANT stall at restart (the
+        new run gets one full timeout of grace from watchdog start) —
+        but a run that hangs before its first heartbeat (compile
+        deadlock) still dumps a bundle once the grace expires."""
+        args = args_factory(
+            stall_timeout_s=1.0, telemetry_dir=str(tmp_path / "b2")
+        )
+        tel = Telemetry.get_instance(args)
+        tel.heartbeat("pipeline.round", 99)  # previous run's mark
+        wd = tel.maybe_start_watchdog(args)  # new run starts (compiling)
+        time.sleep(0.4)
+        assert wd.bundles == []  # stale mark ignored, grace not expired
+        deadline = time.time() + 10
+        while time.time() < deadline and not wd.bundles:
+            time.sleep(0.05)
+        # no fresh heartbeat ever arrived: the first-compile hang fires
+        assert len(wd.bundles) == 1
+        tel.stop_watchdog()
+
+    def test_watchdog_disabled_by_default(self, args_factory):
+        args = args_factory()  # stall_timeout_s defaults to 0
+        assert Telemetry.get_instance(args).maybe_start_watchdog(args) is None
+
+    def test_negative_timeout_rejected(self, args_factory):
+        with pytest.raises(ValueError, match="stall_timeout_s"):
+            args_factory(stall_timeout_s=-1)
+
+
+class TestDeferredMetricsSinglePass:
+    def test_flush_preserves_push_order_one_pass(self):
+        ring = DeferredMetrics()
+        ring.push(4, {"a": jnp.float32(1.0)})
+        ring.push(0, {"a": jnp.float32(2.0)})
+        ring.push(2, {"a": jnp.float32(3.0)})
+        out = ring.flush(upto=4)
+        # push order, NOT round order — the reporter replays history
+        # exactly as the synchronous loop would have produced it
+        assert [r for r, _ in out] == [4, 0, 2]
+        assert [float(t["a"]) for _, t in out] == [1.0, 2.0, 3.0]
+        # invariant: every flush that returned records is exactly one
+        # device fetch
+        assert ring.host_syncs == ring.flushes == 1
+
+
+class TestSysStatsDeviceGauges:
+    class _Dev:
+        def __init__(self, ms):
+            self._ms = ms
+
+        def memory_stats(self):
+            if isinstance(self._ms, Exception):
+                raise self._ms
+            return self._ms
+
+    def test_bytes_limit_exported(self, monkeypatch):
+        import jax
+
+        from fedml_tpu.core import sys_stats
+
+        dev = self._Dev(
+            {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100}
+        )
+        monkeypatch.setattr(jax, "local_devices", lambda: [dev])
+        s = sys_stats.sample_device_stats()
+        assert s == {
+            "device0_bytes_in_use": 10,
+            "device0_peak_bytes": 20,
+            "device0_bytes_limit": 100,
+        }
+
+    def test_sample_system_gauges_lands_in_registry_and_prom(self):
+        from fedml_tpu.core.sys_stats import sample_host_stats
+
+        if not sample_host_stats():
+            pytest.skip("psutil unavailable")
+        tel = Telemetry.get_instance()
+        tel.sample_system_gauges()  # the export_run_artifacts path
+        snap = tel.snapshot()
+        assert "sys_cpu_util_pct" in snap["gauges"]
+        assert "sys_cpu_util_pct" in tel.prometheus_text()
+
+    def test_sysstats_sampler_streams_gauges(self):
+        from fedml_tpu.core.sys_stats import SysStats, sample_host_stats
+        from fedml_tpu.core.tracking import MetricsReporter
+
+        if not sample_host_stats():
+            pytest.skip("psutil unavailable")
+        tel = Telemetry.get_instance()
+        reporter = MetricsReporter(keep_history=True)
+        s = SysStats(reporter, interval_s=0.05, telemetry=tel).start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not reporter.history:
+            time.sleep(0.02)
+        s.stop()
+        assert "sys_cpu_util_pct" in tel.snapshot()["gauges"]
+
+    def test_backend_without_stats_logs_debug_once(self, monkeypatch, caplog):
+        import logging
+
+        import jax
+
+        from fedml_tpu.core import sys_stats
+
+        monkeypatch.setattr(sys_stats, "_DEVICE_STATS_LOGGED", False)
+        monkeypatch.setattr(
+            jax, "local_devices",
+            lambda: [self._Dev(NotImplementedError("no stats"))] * 2,
+        )
+        with caplog.at_level(logging.DEBUG, logger=""):
+            assert sys_stats.sample_device_stats() == {}
+            assert sys_stats.sample_device_stats() == {}
+        hits = [r for r in caplog.records if "memory stats unavailable" in r.message]
+        assert len(hits) == 1 and hits[0].levelno == logging.DEBUG

@@ -25,6 +25,7 @@ def patched_paths(watch, monkeypatch, tmp_path):
     monkeypatch.setattr(watch, "STOP_FILE", stop)
     monkeypatch.setattr(watch, "CAPTURE_PATH", str(tmp_path / "cap.json"))
     monkeypatch.setattr(watch, "LOG_PATH", str(tmp_path / "log"))
+    monkeypatch.setattr(watch, "METRICS_PATH", str(tmp_path / "metrics.prom"))
     return stop
 
 
