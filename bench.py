@@ -80,7 +80,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
-    "telemetry",
+    "telemetry", "serving",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -830,6 +830,142 @@ def run_telemetry(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_serving(on_cpu: bool, smoke: bool = False) -> dict:
+    """Serving-plane phase (fedml_tpu/serving): the continuous
+    micro-batching engine driven at two deterministic burst sizes
+    (pause/submit/resume turns each burst into exactly one micro-batch)
+    so TWO pow2 buckets are exercised. Reports p50/p99 request latency
+    and req/s per bucket, plus the zero-recompile evidence: per-bucket
+    jit trace counts (must be exactly 1 each) held across >= 2 weight
+    hot-swaps mid-run, and a forced queue-full shed counted by
+    ``serving_shed_total`` instead of queue growth.
+
+    ``smoke`` (CI gate): fewer iterations on the same tiny LR model —
+    the contract keys in seconds."""
+    import numpy as np
+    import jax
+
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.serving import ModelEndpoint, ServingEngine
+
+    Telemetry.reset()
+    args = Arguments()
+    args.dataset = "synthetic"
+    args.input_dim = 64
+    args.model = "lr" if (on_cpu or smoke) else "mlp"
+    args.serve_deadline_ms = 0.0  # measuring latency, not shedding
+    args.serve_max_batch = 64
+    args._validate()
+    model = models.create(args, 10)
+    params = model.init(jax.random.PRNGKey(0))
+    endpoint = ModelEndpoint(model, params)
+    engine = ServingEngine(endpoint, args).start()
+    tel = Telemetry.get_instance(args)
+
+    iters = 4 if smoke else 30
+    bursts = (3, 12)  # -> buckets 4 and 16
+    rs = np.random.RandomState(0)
+    out = {
+        "model": model.name,
+        "device": str(jax.devices()[0]),
+        "iters_per_bucket": iters,
+        "buckets": {},
+    }
+    swaps_done = 0
+    burst_inputs = []  # one request set per measured bucket
+    try:
+        for phase_i, burst in enumerate(bursts):
+            lats, t_first = [], None
+            xs = [
+                rs.randn(*model.example_shape).astype(np.float32)
+                for _ in range(burst)
+            ]
+            burst_inputs.append(xs)
+            for it in range(iters):
+                engine.pause()
+                futs = [engine.submit(x) for x in xs]
+                engine.resume()
+                t0 = time.perf_counter()
+                if t_first is None:
+                    t_first = t0
+                for f in futs:
+                    f.result(timeout=120)
+                done = time.perf_counter()
+                if it == 0:
+                    # warmup iteration compiles the bucket; keep it out
+                    # of the latency stats but in the trace counts
+                    t_first = done
+                    continue
+                lats.extend([done - t0] * burst)
+            wall = max(time.perf_counter() - t_first, 1e-9)
+            from fedml_tpu.core.bucketing import bucket_cohort
+
+            b = bucket_cohort(burst, max_size=args.serve_max_batch)
+            out["buckets"][str(b)] = {
+                "burst": burst,
+                "requests": (iters - 1) * burst,
+                "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+                "req_per_sec": round((iters - 1) * burst / wall, 1),
+                "jit_traces": endpoint.trace_counts.get(b, 0),
+            }
+            _progress(
+                f"serving bucket {b}: p50 "
+                f"{out['buckets'][str(b)]['p50_ms']} ms"
+            )
+            # >= 2 hot swaps (one after each bucket phase), then every
+            # measured bucket is re-served below: trace counts must
+            # not move for ANY of them
+            endpoint.swap(model.init(jax.random.PRNGKey(phase_i + 1)))
+            swaps_done += 1
+        for xs in burst_inputs:
+            engine.pause()
+            futs = [engine.submit(x) for x in xs]
+            engine.resume()
+            for f in futs:
+                f.result(timeout=120)
+
+        # forced overload: a paused engine with a tiny queue must shed,
+        # not grow — the bounded-queue contract as a measured number
+        args_shed = Arguments()
+        args_shed.dataset = "synthetic"
+        args_shed.input_dim = 64
+        args_shed.model = args.model
+        args_shed.serve_queue_size = 4
+        args_shed._validate()
+        shed_engine = ServingEngine(
+            ModelEndpoint(model, params), args_shed
+        ).start()
+        shed_engine.pause()
+        shed_futs = [
+            shed_engine.submit(np.zeros(model.example_shape, np.float32))
+            for _ in range(8)
+        ]
+        shed_engine.resume()
+        for f in shed_futs:
+            try:
+                f.result(timeout=60)
+            except Exception:  # noqa: BLE001 — the shed half fails by design
+                pass
+        shed_engine.stop()
+    finally:
+        engine.stop()
+
+    out["swaps"] = swaps_done
+    out["trace_counts"] = {str(k): v for k, v in endpoint.trace_counts.items()}
+    out["one_trace_per_bucket"] = all(
+        v == 1 for v in endpoint.trace_counts.values()
+    ) and len(endpoint.trace_counts) >= 2
+    out["shed_queue_full"] = tel.get_counter(
+        "serving_shed_total", reason="queue_full"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -927,6 +1063,7 @@ _PIPELINE_TIMEOUT_S = 300.0
 # warmup compile + two timed train() runs (telemetry off/on) on the
 # same jitted fns
 _TELEMETRY_TIMEOUT_S = 240.0
+_SERVING_TIMEOUT_S = 180.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1188,6 +1325,10 @@ def _main_guarded() -> None:
     # telemetry-overhead phase (flight recorder on vs off at depth 4):
     # the <2% claim and the host-syncs-identical contract as numbers
     _run_demoted_phase("telemetry", _TELEMETRY_TIMEOUT_S)
+    # serving-plane phase (continuous micro-batching engine): p50/p99
+    # latency + req/s per bucket, one jit trace per bucket across
+    # hot-swaps, bounded-queue shedding
+    _run_demoted_phase("serving", _SERVING_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -1325,6 +1466,8 @@ def _phase_main(argv) -> None:
         out = run_pipeline(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "telemetry":
         out = run_telemetry(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "serving":
+        out = run_serving(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

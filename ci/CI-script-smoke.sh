@@ -25,3 +25,11 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_telemetry_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Serving smoke (two buckets, 2 hot-swaps, CPU): the serving plane must
+# run end-to-end through bench.py's serving phase child and emit the
+# detail.serving contract keys — p50/p99 + req/s per bucket, exactly one
+# jit trace per bucket across the swaps, and a counted queue-full shed.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_serving_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -147,6 +147,24 @@ _DEFAULTS: Dict[str, Any] = {
     # dump a debug bundle (open spans, pending deferred metrics, last-N
     # trace events, host+device sys_stats) to telemetry_dir. 0 disables
     "stall_timeout_s": 0.0,
+    # serving plane (fedml_tpu/serving — `fedml_tpu.cli serve`):
+    # bounded request queue; a full queue sheds new requests
+    # (serving_shed_total{reason=queue_full}) instead of growing
+    "serve_queue_size": 256,
+    # micro-batch cap: the batcher drains up to this many queued
+    # requests into one forward pass (pow2-bucketed below the cap)
+    "serve_max_batch": 64,
+    # linger time while assembling a micro-batch once the first
+    # request is in hand — the latency/occupancy tradeoff knob
+    "serve_batch_wait_ms": 2.0,
+    # default per-request deadline; requests still queued past it are
+    # shed (serving_shed_total{reason=deadline}). 0 disables
+    "serve_deadline_ms": 100.0,
+    # serving batch-shape bucket policy: "pow2" (compile once per
+    # bucket, the training cohort cache's rule) or "exact"
+    "serve_bucket": "pow2",
+    # checkpoint publish/watch poll interval for weight hot-swaps
+    "serve_watch_interval_s": 1.0,
     # sequence-parallel strategy: "ring" or "ulysses"
     "sp_strategy": "ring",
     # ring attention: chunk each hop's K/V shard so the per-chip score
@@ -275,6 +293,8 @@ class Arguments:
             "batch_size",
             "random_seed",
             "pipeline_depth",
+            "serve_queue_size",
+            "serve_max_batch",
         ):
             setattr(self, int_key, int(getattr(self, int_key)))
         if getattr(self, "pipeline_depth", 1) < 1:
@@ -299,8 +319,27 @@ class Arguments:
             "fedprox_mu",
             "compression_topk_ratio",
             "stall_timeout_s",
+            "serve_batch_wait_ms",
+            "serve_deadline_ms",
+            "serve_watch_interval_s",
         ):
             setattr(self, float_key, float(getattr(self, float_key)))
+        if self.serve_queue_size < 1 or self.serve_max_batch < 1:
+            raise ValueError(
+                f"serve_queue_size={self.serve_queue_size} / "
+                f"serve_max_batch={self.serve_max_batch}: both must be >= 1"
+            )
+        for nonneg_key in (
+            "serve_batch_wait_ms", "serve_deadline_ms", "serve_watch_interval_s",
+        ):
+            if getattr(self, nonneg_key) < 0:
+                raise ValueError(
+                    f"{nonneg_key}={getattr(self, nonneg_key)}: must be >= 0"
+                )
+        if getattr(self, "serve_bucket", "pow2") not in ("pow2", "exact"):
+            raise ValueError(
+                f"serve_bucket {self.serve_bucket!r}: pick 'pow2' or 'exact'"
+            )
         if getattr(self, "stall_timeout_s", 0.0) < 0:
             raise ValueError(
                 f"stall_timeout_s={self.stall_timeout_s}: must be >= 0 "

@@ -13,6 +13,10 @@ dependency):
   distribution zip (cli/cli.py:141-250's mlops-core packaging, minus
   the platform-specific templates: the package carries the user source
   + entry + a manifest the edge agent knows how to run).
+- ``serve``    — beyond the reference (which hands trained models to an
+  external MLOps serving tier): stand up the TPU-native serving plane
+  (``fedml_tpu/serving``) for the federated global model, hot-swapping
+  weights from a checkpoint dir as the trainer publishes new rounds.
 
 State lives under ``~/.fedml_tpu/`` (override: FEDML_TPU_HOME).
 """
@@ -141,6 +145,91 @@ def cmd_build(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve the federated global model over LOCAL or GRPC.
+
+    Builds the model from the YAML config (``--cf``), restores the
+    newest restorable checkpoint from ``--checkpoint-dir`` (corrupt
+    latest falls back to the previous version — CheckpointWatcher
+    semantics), starts the micro-batching engine, and keeps hot-swapping
+    weights as the trainer publishes new rounds. ``--dry-run`` builds
+    everything, prints one status JSON line, and exits — the smoke seam
+    for tests and deploy scripts."""
+    import importlib
+
+    jax = importlib.import_module("jax")
+    from .arguments import Arguments
+    from . import models
+    from .core.checkpoint import CheckpointWatcher
+    from .serving import ModelEndpoint, ServingEngine, ServingFrontend
+    from .serving.frontends import build_serving_com
+
+    ns = argparse.Namespace(
+        yaml_config_file=args.cf or "",
+        rank=0,
+        role="server",
+        run_id=args.run_id,
+    )
+    a = Arguments(ns)
+    model = models.create(a, int(args.output_dim))
+    params = model.init(jax.random.PRNGKey(int(a.random_seed)))
+    endpoint = ModelEndpoint(model, params, version=0)
+
+    watcher = None
+    if args.checkpoint_dir:
+        watcher = CheckpointWatcher(
+            args.checkpoint_dir, poll_interval_s=a.serve_watch_interval_s
+        )
+        update = watcher.poll()
+        if update is not None:
+            step, state = update
+            endpoint.swap_from_checkpoint_state(state, version=step)
+            print(f"serve: loaded checkpoint step {step}", file=sys.stderr)
+
+    engine = ServingEngine(endpoint, a).start()
+    status = {
+        "model": model.name,
+        "version": endpoint.version,
+        "backend": args.backend,
+        "queue_size": engine.queue_size,
+        "max_batch": engine.max_batch,
+        "bucket_policy": engine.bucket_policy,
+        "deadline_ms": a.serve_deadline_ms,
+        "checkpoint_dir": args.checkpoint_dir,
+    }
+    if args.dry_run:
+        print(json.dumps(status))
+        engine.stop()
+        if watcher is not None:
+            watcher.close()
+        return 0
+
+    com = build_serving_com(a, rank=0, size=int(args.world_size), backend=args.backend)
+    frontend = ServingFrontend(engine, com, a, rank=0)
+    if watcher is not None:
+        watcher.watch(
+            lambda step, state: endpoint.swap_from_checkpoint_state(
+                state, version=step
+            )
+        )
+    print(f"serve: ready ({json.dumps(status)})", file=sys.stderr)
+    try:
+        frontend.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.stop()
+        engine.stop()
+        if watcher is not None:
+            watcher.close()
+        from .core.telemetry import Telemetry
+
+        Telemetry.get_instance().export_run_artifacts(
+            getattr(a, "telemetry_dir", None)
+        )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fedml-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -157,6 +246,18 @@ def build_parser() -> argparse.ArgumentParser:
     login.set_defaults(fn=cmd_login)
 
     sub.add_parser("logout").set_defaults(fn=cmd_logout)
+
+    serve = sub.add_parser("serve")
+    serve.add_argument("--cf", "--yaml_config_file", dest="cf", default="")
+    serve.add_argument("--checkpoint-dir", default=None)
+    serve.add_argument(
+        "--backend", default="LOCAL", type=str.upper, choices=["LOCAL", "GRPC"]
+    )
+    serve.add_argument("--world-size", type=int, default=2)
+    serve.add_argument("--output-dim", type=int, default=10)
+    serve.add_argument("--run-id", dest="run_id", default="0")
+    serve.add_argument("--dry-run", action="store_true")
+    serve.set_defaults(fn=cmd_serve)
 
     build = sub.add_parser("build")
     build.add_argument("-t", "--type", required=True, choices=["client", "server"])

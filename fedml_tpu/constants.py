@@ -83,3 +83,9 @@ MSG_TYPE_SILO_FINISH = 21
 # server-internal: aggregation deadline fired (straggler handling —
 # beyond the reference, which always waits for every client)
 MSG_TYPE_S2S_AGG_DEADLINE = 30
+
+# Serving plane (fedml_tpu/serving — beyond the reference, which ships
+# trained models to an external MLOps tier): one request/response pair
+# over any comm backend; the payload keys live on the frontends.
+MSG_TYPE_C2S_INFER_REQUEST = 40
+MSG_TYPE_S2C_INFER_RESPONSE = 41

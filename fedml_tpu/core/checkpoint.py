@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -57,6 +58,16 @@ class RoundCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
+    def steps(self) -> List[int]:
+        """All on-disk steps, ascending (the watcher's fallback walks
+        this newest-first when the latest refuses to restore). Reloads
+        the manager's directory view first: orbax caches the listing at
+        construction, and the watch seam exists precisely to see steps
+        written by ANOTHER process after this manager was built."""
+        if hasattr(self.manager, "reload"):
+            self.manager.reload()
+        return sorted(int(s) for s in self.manager.all_steps())
+
     def restore(
         self,
         round_idx: Optional[int] = None,
@@ -97,3 +108,101 @@ class RoundCheckpointer:
 
     def close(self) -> None:
         self.manager.close()
+
+
+class CheckpointWatcher:
+    """``latest_step()``-driven publish/watch seam over a checkpoint dir.
+
+    The training side "publishes" by simply saving (the step index IS
+    the version); any subscriber — the serving plane's hot-swap loop is
+    the designed consumer — polls this watcher. Semantics are
+    **latest-wins**: each poll returns the NEWEST restorable step newer
+    than the last one published (steps that appeared and were
+    superseded between polls are skipped, never delivered) — exactly
+    what a hot-swap consumer wants; a per-version audit trail should
+    read ``RoundCheckpointer.steps()`` itself.
+
+    Fault contract: a corrupt or partially-written latest step must
+    degrade the subscriber to the PREVIOUS version, never crash it — a
+    trainer killed mid-save (or a shared filesystem showing a torn
+    write) is a normal event in a long-running federation. A step that
+    fails to restore is remembered as bad and never retried, so the
+    poll loop cannot wedge on it; the newest older step that restores
+    is returned instead.
+    """
+
+    def __init__(self, checkpoint_dir: str, poll_interval_s: float = 1.0) -> None:
+        self.ckpt = RoundCheckpointer(checkpoint_dir)
+        self.poll_interval_s = float(poll_interval_s)
+        self.published_step: Optional[int] = None
+        self._bad: set = set()
+        self._closed = threading.Event()  # stops every watch() loop
+        self._threads: List[threading.Thread] = []
+
+    def poll(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """The newest restorable step newer than the last published
+        one, as ``(step, state)``; None when nothing new (latest-wins:
+        intermediate steps saved since the last poll are skipped)."""
+        try:
+            steps = self.ckpt.steps()
+        except Exception:  # noqa: BLE001 — a listing error is "nothing new"
+            logging.exception("checkpoint watcher: step listing failed")
+            return None
+        floor = -1 if self.published_step is None else self.published_step
+        for step in sorted(
+            (s for s in steps if s > floor and s not in self._bad),
+            reverse=True,
+        ):
+            try:
+                state = self.ckpt.restore(step)
+            except Exception:  # noqa: BLE001 — corrupt/partial: fall back
+                logging.exception(
+                    "checkpoint watcher: step %d failed to restore; "
+                    "falling back to the previous version", step,
+                )
+                self._bad.add(step)
+                continue
+            if state is None:
+                self._bad.add(step)
+                continue
+            self.published_step = step
+            return step, state
+        return None
+
+    def watch(
+        self,
+        callback: Callable[[int, Dict[str, Any]], None],
+        stop_event: Optional[threading.Event] = None,
+    ) -> threading.Thread:
+        """Poll on a daemon thread, invoking ``callback(step, state)``
+        per new version until ``stop_event`` (or ``close()``) fires. A
+        callback error is logged, not fatal — the next version still
+        gets delivered."""
+        stop = stop_event if stop_event is not None else threading.Event()
+
+        def loop() -> None:
+            while not stop.is_set() and not self._closed.is_set():
+                update = self.poll()
+                if update is not None:
+                    try:
+                        callback(*update)
+                    except Exception:  # noqa: BLE001
+                        logging.exception("checkpoint watch callback failed")
+                stop.wait(self.poll_interval_s)
+
+        thread = threading.Thread(
+            target=loop, daemon=True, name="checkpoint-watcher"
+        )
+        thread.stop_event = stop  # type: ignore[attr-defined]
+        thread.start()
+        self._threads.append(thread)
+        return thread
+
+    def close(self) -> None:
+        # stop the watch loops BEFORE closing the manager they poll —
+        # otherwise every interval logs a failed listing until exit
+        self._closed.set()
+        for t in self._threads:
+            t.join(timeout=self.poll_interval_s + 1.0)
+        self._threads.clear()
+        self.ckpt.close()

@@ -45,10 +45,14 @@ from __future__ import annotations
 import logging
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import numpy as np
 
+# bucket/pad helpers live in core/bucketing.py (shared with the serving
+# plane's micro-batcher); re-exported here for compat — both names were
+# part of this module's public surface before the factor-out
+from .bucketing import bucket_cohort, pad_cohort_idx  # noqa: F401
 from .tracking import DeferredMetrics
 
 __all__ = ["RoundPipeline", "bucket_cohort", "pad_cohort_idx"]
@@ -67,48 +71,6 @@ def _rng_chain(rng, n: int):
 
     _, (keys, heads) = jax.lax.scan(step, rng, None, length=n)
     return keys, heads
-
-
-def bucket_cohort(
-    n: int,
-    policy: str = "pow2",
-    max_size: Optional[int] = None,
-    shard_multiple: int = 1,
-) -> int:
-    """Cohort size -> compile-cache bucket size.
-
-    ``pow2`` rounds up to the next power of two (capped at ``max_size``,
-    the total client count — a bucket can never exceed the federation).
-    A mesh's ``clients`` axis must still tile the bucket; when the
-    power-of-two bucket is not a multiple of ``shard_multiple`` the
-    exact size is used instead (it was already validated to tile).
-    """
-    if policy not in ("pow2", "exact"):
-        raise ValueError(f"pipeline_bucket {policy!r}: pick 'pow2' or 'exact'")
-    if policy == "exact" or n <= 0:
-        return n
-    b = 1 << (int(n) - 1).bit_length()
-    if max_size is not None:
-        b = min(b, int(max_size))
-    if b < n or b % max(1, shard_multiple) != 0:
-        return n
-    return b
-
-
-def pad_cohort_idx(idx: np.ndarray, bucket: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad sampled client indices up to ``bucket``; returns
-    ``(padded_idx, valid)`` where ``valid`` is 1.0 for real slots and
-    0.0 for padding. Padded slots repeat ``idx[0]`` (a real, in-range
-    index — the round fn zeroes their batch mask so they train on
-    nothing and aggregate with weight zero)."""
-    idx = np.asarray(idx, dtype=np.int32)
-    n = idx.shape[0]
-    valid = np.ones((bucket,), dtype=np.float32)
-    if bucket == n:
-        return idx, valid
-    pad = np.full((bucket - n,), idx[0], dtype=np.int32)
-    valid[n:] = 0.0
-    return np.concatenate([idx, pad]), valid
 
 
 class RoundPipeline:

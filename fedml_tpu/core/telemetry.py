@@ -268,20 +268,40 @@ class Telemetry:
         with self._lock:
             self._gauges[self._key(name, tags)] = float(value)
 
-    def observe(self, name: str, value: float, **tags: Any) -> None:
-        """Histogram-style observation (count / sum / min / max)."""
+    def observe(
+        self, name: str, value: float, buckets=None, **tags: Any
+    ) -> None:
+        """Histogram-style observation (count / sum / min / max).
+
+        With ``buckets`` (a sequence of upper bounds, fixed by the
+        series' first observation), the series also keeps cumulative
+        ``le`` bucket counts and exposes as a full Prometheus
+        *histogram* (``_bucket{le=...}`` lines + ``_sum``/``_count``)
+        instead of the bare summary — the serving plane's latency
+        series need quantile-estimable exports, not just a mean."""
         if not self._enabled:
             return
         v = float(value)
         with self._lock:
-            h = self._hists.setdefault(
-                self._key(name, tags),
-                {"count": 0.0, "sum": 0.0, "min": v, "max": v},
-            )
+            key = self._key(name, tags)
+            h = self._hists.get(key)
+            if h is None:
+                h = {"count": 0.0, "sum": 0.0, "min": v, "max": v}
+                if buckets is not None:
+                    # bounds attach ONLY at series creation: adopting
+                    # them later would leave earlier observations out
+                    # of every finite bucket while +Inf uses the full
+                    # count — a non-cumulative (invalid) histogram
+                    h["le"] = tuple(sorted(float(b) for b in buckets))
+                    h["le_counts"] = [0] * len(h["le"])
+                self._hists[key] = h
             h["count"] += 1
             h["sum"] += v
             h["min"] = min(h["min"], v)
             h["max"] = max(h["max"], v)
+            for i, bound in enumerate(h.get("le", ())):
+                if v <= bound:  # cumulative: every bound >= v counts
+                    h["le_counts"][i] += 1
 
     def get_counter(self, name: str, **tags: Any) -> float:
         with self._lock:
@@ -387,7 +407,13 @@ class Telemetry:
             counters = {self._fmt(n, t): v for (n, t), v in self._counters.items()}
             gauges = {self._fmt(n, t): v for (n, t), v in self._gauges.items()}
             hists = {
-                self._fmt(n, t): dict(h) for (n, t), h in self._hists.items()
+                # copy le_counts too: the snapshot must not alias the
+                # live (still-mutating) cumulative bucket list
+                self._fmt(n, t): {
+                    k: (list(v) if isinstance(v, list) else v)
+                    for k, v in h.items()
+                }
+                for (n, t), h in self._hists.items()
             }
             heartbeats = {
                 n: {"value": v, "age_s": round(time.monotonic() - ts, 3)}
@@ -415,8 +441,8 @@ class Telemetry:
         """Standard Prometheus text exposition of the registry."""
         base = {"run_id": self.run_id, "rank": self.rank, "role": self.role}
 
-        def labels(tags: Tuple) -> str:
-            merged = {**base, **dict(tags)}
+        def labels(tags: Tuple, **extra: Any) -> str:
+            merged = {**base, **dict(tags), **extra}
             inner = ",".join(
                 f'{_sanitize_metric(k)}="{_escape_label_value(v)}"'
                 for k, v in sorted(
@@ -445,9 +471,21 @@ class Telemetry:
             lines.append(f"{m}{labels(tags)} {v}")
         for (name, tags), h in hists:
             m = _sanitize_metric(name)
+            # explicit-bucket series export as real histograms (the
+            # serving latency/occupancy series); bucket-less ones stay
+            # the lighter summary shape they always were
+            kind = "histogram" if "le" in h else "summary"
             if m not in seen_type:
-                lines.append(f"# TYPE {m} summary")
+                lines.append(f"# TYPE {m} {kind}")
                 seen_type.add(m)
+            if "le" in h:
+                for bound, c in zip(h["le"], h["le_counts"]):
+                    lines.append(
+                        f"{m}_bucket{labels(tags, le=bound)} {float(c)}"
+                    )
+                lines.append(
+                    f'{m}_bucket{labels(tags, le="+Inf")} {h["count"]}'
+                )
             lines.append(f"{m}_count{labels(tags)} {h['count']}")
             lines.append(f"{m}_sum{labels(tags)} {h['sum']}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,51 @@
+"""TPU-native model serving plane for the federated global model.
+
+The ROADMAP's "serve heavy traffic" leg: an online inference subsystem
+that reuses the training stack's own machinery instead of exporting to
+an external tier — the shape-bucketed jit compile cache
+(``core/bucketing.py``), the checkpoint publish/watch seam
+(``core/checkpoint.py``), the telemetry registry/flight recorder
+(``core/telemetry.py``) and the comm seam with its fault-injection and
+instrumentation wrappers (``core/comm``).
+
+Pieces (each documented in its module; overview in docs/serving.md):
+
+- ``ModelEndpoint`` — versioned params + jit-once forward; hot swaps
+  are atomic and provably retrace-free;
+- ``ServingEngine`` — bounded queue, continuous micro-batching into
+  pow2 buckets, deadline/queue-full load shedding;
+- ``ServingFrontend`` / ``ServingClient`` — the request/response pair
+  over LOCAL or gRPC comm backends (``fedml_tpu.cli serve``).
+"""
+
+from .admission import (  # noqa: F401
+    AdmissionController,
+    DeadlineExceededError,
+    QueueFullError,
+    ServingShedError,
+)
+from .batcher import MicroBatcher  # noqa: F401
+from .endpoint import ModelEndpoint  # noqa: F401
+from .engine import LATENCY_BUCKETS_S, InferenceRequest, ServingEngine  # noqa: F401
+from .frontends import (  # noqa: F401
+    ServingClient,
+    ServingFrontend,
+    ServingUnavailableError,
+    build_serving_com,
+)
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceededError",
+    "InferenceRequest",
+    "LATENCY_BUCKETS_S",
+    "MicroBatcher",
+    "ModelEndpoint",
+    "QueueFullError",
+    "ServingClient",
+    "ServingEngine",
+    "ServingFrontend",
+    "ServingShedError",
+    "ServingUnavailableError",
+    "build_serving_com",
+]

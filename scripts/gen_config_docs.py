@@ -185,6 +185,10 @@ GROUPS = [
         "moe_aux_weight", "grad_accum_steps", "matmul_precision",
     ]),
     ("Device", ["using_gpu", "device_type", "gpu_mapping_file"]),
+    ("Serving", [
+        "serve_queue_size", "serve_max_batch", "serve_batch_wait_ms",
+        "serve_deadline_ms", "serve_bucket", "serve_watch_interval_s",
+    ]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
         "telemetry", "telemetry_dir", "stall_timeout_s",

@@ -108,6 +108,7 @@ PHASES = [
     ("sweep_512", ["--phase", "sweep", "--cohort", "512"], 360.0),
     ("mesh", ["--phase", "mesh"], 240.0),
     ("telemetry", ["--phase", "telemetry"], 300.0),
+    ("serving", ["--phase", "serving"], 300.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

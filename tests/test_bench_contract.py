@@ -71,6 +71,17 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"telemetry"' in parent or "'telemetry'" in parent
 
+    def test_serving_phase_contract(self):
+        """detail.serving ships the serving-plane latency/throughput
+        figures: the phase is in the child vocabulary and the parent
+        stitches it (like pipeline/telemetry, it runs demoted on the
+        CPU fallback)."""
+        assert "serving" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"serving"' in parent or "'serving'" in parent
+
 
 class TestPhaseChild:
     def _run_child(self, phase: str, timeout: int, smoke: bool = False) -> dict:
@@ -136,6 +147,25 @@ class TestPhaseChild:
         assert "overhead_pct" in d
         assert d["host_syncs_match"] is True
         assert d["trace_events"] > 0
+
+    @pytest.mark.slow  # ~8s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's serving smoke block
+    def test_serving_smoke_child_writes_valid_json(self):
+        """The CI serving smoke invocation (two buckets, 2 hot-swaps,
+        CPU): the serving plane runs end-to-end through bench.py's
+        serving phase child and emits the detail.serving contract keys
+        — p50/p99 latency and req/s for at least two batch buckets,
+        exactly one jit trace per bucket across the whole run including
+        the hot swaps, and a counted queue-full shed."""
+        d = self._run_child("serving", 420, smoke=True)
+        assert len(d["buckets"]) >= 2, d
+        for b, stats in d["buckets"].items():
+            assert stats["p50_ms"] > 0 and stats["p99_ms"] >= stats["p50_ms"]
+            assert stats["req_per_sec"] > 0
+            assert stats["jit_traces"] == 1, (b, stats)
+        assert d["swaps"] >= 2
+        assert d["one_trace_per_bucket"] is True
+        assert d["shed_queue_full"] > 0
 
     @pytest.mark.slow  # subprocess + 2-virtual-device mesh round
     def test_mesh_cpu_child_writes_valid_json(self):

@@ -1,0 +1,531 @@
+"""Serving plane (fedml_tpu/serving): continuous micro-batching,
+zero-recompile hot swap, admission control, comm frontends (incl. fault
+injection in both wrap orders), checkpoint publish/watch, telemetry
+exposition. The compile-cache contract under test is the PR's core
+claim: one jit trace per pow2 batch bucket for the WHOLE run, weight
+swaps included."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tests.conftest import make_args
+
+pytestmark = pytest.mark.smoke
+
+
+def _build_endpoint(**kw):
+    from fedml_tpu import models
+    from fedml_tpu.serving import ModelEndpoint
+
+    args = make_args(dataset="synthetic", input_dim=8, model="lr", **kw)
+    model = models.create(args, 4)
+    params = model.init(jax.random.PRNGKey(0))
+    return args, model, params, ModelEndpoint(model, params)
+
+
+def _burst(engine, xs, timeout=30):
+    """pause/submit/resume: N submits -> exactly one N-row micro-batch."""
+    engine.pause()
+    futs = [engine.submit(x) for x in xs]
+    engine.resume()
+    return [f.result(timeout=timeout) for f in futs]
+
+
+class TestSharedBucketing:
+    def test_round_pipeline_reexports_shared_helpers(self):
+        # satellite 1: one bucketing rule, two consumers — the training
+        # pipeline's public names must BE the shared module's objects
+        from fedml_tpu.core import bucketing, round_pipeline
+
+        assert round_pipeline.bucket_cohort is bucketing.bucket_cohort
+        assert round_pipeline.pad_cohort_idx is bucketing.pad_cohort_idx
+
+    def test_pad_batch_pads_with_zero_rows_and_valid_mask(self):
+        from fedml_tpu.core.bucketing import pad_batch
+
+        xs = np.ones((3, 5), np.float32)
+        padded, valid = pad_batch(xs, 8)
+        assert padded.shape == (8, 5)
+        assert np.all(padded[3:] == 0) and np.all(padded[:3] == 1)
+        assert valid.tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+        same, valid_same = pad_batch(xs, 3)
+        assert same is xs or np.array_equal(same, xs)
+        assert valid_same.tolist() == [1, 1, 1]
+        with pytest.raises(ValueError):
+            pad_batch(xs, 2)
+
+    def test_bucket_policy_validation(self):
+        from fedml_tpu.core.bucketing import bucket_cohort
+
+        assert bucket_cohort(5) == 8
+        assert bucket_cohort(5, max_size=6) == 6  # capped at the population
+        assert bucket_cohort(5, "exact") == 5
+        with pytest.raises(ValueError):
+            bucket_cohort(5, "fibonacci")
+
+
+class TestEngineMicroBatching:
+    def test_burst_is_one_batch_one_trace_and_correct(self):
+        from fedml_tpu.serving import ServingEngine
+
+        args, model, params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            xs = [
+                np.random.RandomState(i).randn(8).astype(np.float32)
+                for i in range(3)
+            ]
+            outs = _burst(eng, xs)
+            ref = np.asarray(model.apply(params, np.stack(xs)))
+            assert np.allclose(np.stack(outs), ref, atol=1e-5)
+            assert ep.trace_counts == {4: 1}
+
+    def test_varying_burst_sizes_reuse_the_bucket(self):
+        from fedml_tpu.serving import ServingEngine
+
+        args, _model, _params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            for n in (3, 2, 4, 1, 3):
+                _burst(eng, [np.zeros(8, np.float32)] * n)
+            # 1->1, 2->2, {3,4}->4: three compiled shapes, once each
+            assert ep.trace_counts == {1: 1, 2: 1, 4: 1}
+
+    def test_bad_request_shape_rejected_at_submit(self):
+        from fedml_tpu.serving import ServingEngine
+
+        args, _model, _params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            with pytest.raises(ValueError, match="example shape"):
+                eng.submit(np.zeros(9, np.float32))
+
+
+class TestHotSwap:
+    def test_swap_changes_output_without_retrace(self):
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import ServingEngine
+
+        args, model, params, ep = _build_endpoint()
+        x = np.random.RandomState(0).randn(8).astype(np.float32)
+        with ServingEngine(ep, args) as eng:
+            (before,) = _burst(eng, [x])
+            p2 = model.init(jax.random.PRNGKey(7))
+            ep.swap(p2)
+            ep.swap(model.init(jax.random.PRNGKey(8)), version=42)
+            (after,) = _burst(eng, [x])
+            ref = np.asarray(
+                model.apply(jax.tree.map(np.asarray, ep.params()), x[None])
+            )[0]
+            assert np.allclose(after, ref, atol=1e-5)
+            assert not np.allclose(before, after)
+            # the zero-recompile claim: two swaps, trace counter unmoved
+            assert ep.trace_counts == {1: 1}
+            assert ep.version == 42 and ep.swaps == 2
+            tel = Telemetry.get_instance()
+            assert tel.get_counter("serving_swaps_total") == 2
+            assert tel.get_counter("serving_retraces_total", bucket=1) == 1
+
+    def test_mismatched_tree_is_rejected_loudly(self):
+        from fedml_tpu import models
+
+        args, _model, _params, ep = _build_endpoint()
+        other_args = make_args(dataset="synthetic", input_dim=9, model="lr")
+        other = models.create(other_args, 4)
+        with pytest.raises(ValueError, match="never retrace"):
+            ep.swap(other.init(jax.random.PRNGKey(0)))
+
+
+class TestAdmissionControl:
+    def test_full_queue_sheds_with_counted_total(self):
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import QueueFullError, ServingEngine
+
+        args, _model, _params, ep = _build_endpoint(serve_queue_size=2)
+        with ServingEngine(ep, args) as eng:
+            eng.pause()
+            f1 = eng.submit(np.zeros(8, np.float32))
+            f2 = eng.submit(np.zeros(8, np.float32))
+            f3 = eng.submit(np.zeros(8, np.float32))
+            # shed immediately — bounded queue, not unbounded growth
+            assert isinstance(f3.exception(timeout=1), QueueFullError)
+            eng.resume()
+            f1.result(timeout=30)
+            f2.result(timeout=30)
+        tel = Telemetry.get_instance()
+        assert tel.get_counter("serving_shed_total", reason="queue_full") == 1
+        assert tel.get_counter("serving_requests_total") == 3
+
+    def test_stop_with_full_queue_does_not_deadlock_or_abandon(self):
+        from fedml_tpu.serving import ServingEngine, ServingShedError
+
+        args, _model, _params, ep = _build_endpoint(serve_queue_size=2)
+        eng = ServingEngine(ep, args).start()
+        eng.pause()
+        futs = [eng.submit(np.zeros(8, np.float32)) for _ in range(2)]
+        t0 = time.monotonic()
+        eng.stop()  # queue is at capacity; stop must still return
+        assert time.monotonic() - t0 < 4.0
+        # queued futures are failed typed, never silently abandoned
+        for f in futs:
+            assert isinstance(f.exception(timeout=1), ServingShedError)
+        # and a submit AFTER stop fails immediately too
+        late = eng.submit(np.zeros(8, np.float32))
+        assert isinstance(late.exception(timeout=1), ServingShedError)
+
+    def test_pause_after_resume_waits_for_a_fresh_park(self):
+        """A pause() right after resume() must not be satisfied by the
+        previous pause's acknowledgement — the burst submitted after it
+        has to land in ONE batch (generation-counted handshake)."""
+        from fedml_tpu.serving import ServingEngine
+
+        args, _model, _params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            for _ in range(20):
+                _burst(eng, [np.zeros(8, np.float32)] * 3)
+            # 20 bursts of 3, zero stray partial batches: only bucket 4
+            assert ep.trace_counts == {4: 1}
+
+    def test_expired_deadline_sheds_before_forward(self):
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import DeadlineExceededError, ServingEngine
+
+        args, _model, _params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            eng.pause()
+            fut = eng.submit(np.zeros(8, np.float32), deadline_s=0.01)
+            live = eng.submit(np.zeros(8, np.float32))  # no default: 100ms
+            time.sleep(0.05)
+            eng.resume()
+            assert isinstance(fut.exception(timeout=1), DeadlineExceededError)
+            live.result(timeout=30)
+        tel = Telemetry.get_instance()
+        assert tel.get_counter("serving_shed_total", reason="deadline") == 1
+
+
+def _start_frontend(engine, com, args):
+    from fedml_tpu.serving import ServingFrontend
+
+    fe = ServingFrontend(engine, com, args)
+    t = threading.Thread(target=fe.serve_forever, daemon=True)
+    t.start()
+    return fe
+
+
+class TestFrontends:
+    def test_local_roundtrip(self):
+        from fedml_tpu.serving import ServingClient, ServingEngine
+        from fedml_tpu.serving.frontends import build_serving_com
+
+        args, model, params, ep = _build_endpoint(run_id="srv_local")
+        eng = ServingEngine(ep, args).start()
+        fe = _start_frontend(eng, build_serving_com(args, 0, 2), args)
+        cl = ServingClient(build_serving_com(args, 1, 2), rank=1, args=args)
+        try:
+            x = np.random.RandomState(1).randn(8).astype(np.float32)
+            y = cl.request(x, timeout_s=10.0)
+            ref = np.asarray(model.apply(params, x[None]))[0]
+            assert np.allclose(y, ref, atol=1e-5)
+        finally:
+            cl.close()
+            fe.stop()
+            eng.stop()
+
+    @pytest.mark.parametrize("faults_outermost", [True, False])
+    def test_dropped_request_counted_and_retried(self, faults_outermost):
+        """Satellite 3, drop half: an injected request drop must show in
+        comm_faults_injected_total AND drive the client's retry path to
+        a successful answer — in BOTH wrapper compositions (counting
+        inside faults, the managers' order, and the reverse)."""
+        from fedml_tpu import constants
+        from fedml_tpu.core.comm.faults import FaultInjector
+        from fedml_tpu.core.comm.instrument import wrap_instrumented
+        from fedml_tpu.core.managers import _build_com_manager
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import ServingClient, ServingEngine
+        from fedml_tpu.serving.frontends import build_serving_com
+
+        rid = f"srv_drop_{int(faults_outermost)}"
+        args, model, params, ep = _build_endpoint(run_id=rid)
+        eng = ServingEngine(ep, args).start()
+        fe = _start_frontend(eng, build_serving_com(args, 0, 2), args)
+        raw = _build_com_manager(args, 1, 2, "LOCAL")
+        fault_kw = dict(
+            drop_prob=1.0, max_faults=1,
+            msg_types=[constants.MSG_TYPE_C2S_INFER_REQUEST],
+        )
+        if faults_outermost:
+            com_c = FaultInjector(wrap_instrumented(raw, args), **fault_kw)
+        else:
+            com_c = wrap_instrumented(FaultInjector(raw, **fault_kw), args)
+        cl = ServingClient(com_c, rank=1, args=args)
+        try:
+            x = np.random.RandomState(2).randn(8).astype(np.float32)
+            y = cl.request(x, timeout_s=0.5, retries=2)
+            ref = np.asarray(model.apply(params, x[None]))[0]
+            assert np.allclose(y, ref, atol=1e-5)
+            tel = Telemetry.get_instance()
+            assert tel.get_counter(
+                "comm_faults_injected_total", fault="drop",
+                msg_type=constants.MSG_TYPE_C2S_INFER_REQUEST,
+            ) == 1
+            assert tel.get_counter("serving_client_retries_total") >= 1
+        finally:
+            cl.close()
+            fe.stop()
+            eng.stop()
+
+    def test_delayed_request_sheds_stale_and_retries(self):
+        """Satellite 3, delay half: an injected delay lands the request
+        past its carried deadline — the server sheds it (counted) and
+        the client's retry succeeds. Telemetry carries evidence of the
+        injection, the shed, and the retry."""
+        from fedml_tpu import constants
+        from fedml_tpu.core.comm.faults import FaultInjector
+        from fedml_tpu.core.comm.instrument import wrap_instrumented
+        from fedml_tpu.core.managers import _build_com_manager
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import ServingClient, ServingEngine
+        from fedml_tpu.serving.frontends import build_serving_com
+
+        args, model, params, ep = _build_endpoint(run_id="srv_delay")
+        eng = ServingEngine(ep, args).start()
+        fe = _start_frontend(eng, build_serving_com(args, 0, 2), args)
+        raw = _build_com_manager(args, 1, 2, "LOCAL")
+        com_c = FaultInjector(
+            wrap_instrumented(raw, args),
+            delay_s=0.4, delay_prob=1.0, max_faults=1,
+            msg_types=[constants.MSG_TYPE_C2S_INFER_REQUEST],
+        )
+        cl = ServingClient(com_c, rank=1, args=args)
+        try:
+            x = np.random.RandomState(3).randn(8).astype(np.float32)
+            y = cl.request(x, timeout_s=1.5, retries=2, deadline_s=0.1)
+            ref = np.asarray(model.apply(params, x[None]))[0]
+            assert np.allclose(y, ref, atol=1e-5)
+            tel = Telemetry.get_instance()
+            assert tel.get_counter(
+                "comm_faults_injected_total", fault="delay",
+                msg_type=constants.MSG_TYPE_C2S_INFER_REQUEST,
+            ) == 1
+            # the delayed copy arrived expired -> deadline shed on the
+            # server; the client's second attempt answered
+            assert tel.get_counter("serving_shed_total", reason="deadline") >= 1
+            assert tel.get_counter("serving_client_retries_total") >= 1
+        finally:
+            cl.close()
+            fe.stop()
+            eng.stop()
+
+    def test_grpc_unary_roundtrip(self):
+        """The msgpack-over-gRPC unary backend serves inference with
+        the same frontend code as LOCAL — one flag flip."""
+        from fedml_tpu.serving import ServingClient, ServingEngine
+        from fedml_tpu.serving.frontends import build_serving_com
+
+        port_base = 19200 + (os.getpid() % 397) * 2
+        args, model, params, ep = _build_endpoint(
+            run_id="srv_grpc", grpc_port_base=port_base
+        )
+        eng = ServingEngine(ep, args).start()
+        fe = _start_frontend(eng, build_serving_com(args, 0, 2, "GRPC"), args)
+        cl = ServingClient(
+            build_serving_com(args, 1, 2, "GRPC"), rank=1, args=args
+        )
+        try:
+            x = np.random.RandomState(4).randn(8).astype(np.float32)
+            y = cl.request(x, timeout_s=10.0)
+            ref = np.asarray(model.apply(params, x[None]))[0]
+            assert np.allclose(y, ref, atol=1e-5)
+        finally:
+            cl.close()
+            fe.stop()
+            eng.stop()
+
+
+class TestCheckpointPublishWatch:
+    def _save(self, ckpt, step, params, scale):
+        state = {
+            "params": jax.tree.map(lambda a: np.asarray(a) * scale, params),
+            "round_idx": step,
+        }
+        ckpt.save(step, state)
+
+    def test_watcher_publishes_each_new_step_once(self, tmp_path):
+        from fedml_tpu.core.checkpoint import CheckpointWatcher, RoundCheckpointer
+
+        _args, _model, params, _ep = _build_endpoint()
+        ckpt = RoundCheckpointer(str(tmp_path))
+        watcher = CheckpointWatcher(str(tmp_path))
+        assert watcher.poll() is None  # nothing published yet
+        self._save(ckpt, 0, params, 1.0)
+        step, _state = watcher.poll()
+        assert step == 0
+        assert watcher.poll() is None  # no re-publish
+        self._save(ckpt, 1, params, 2.0)
+        step, _state = watcher.poll()
+        assert step == 1
+        ckpt.close()
+        watcher.close()
+
+    def test_corrupt_latest_falls_back_to_previous(self, tmp_path):
+        """Satellite 2: a corrupt/partial latest checkpoint must fall
+        back to the previous version instead of crashing the
+        subscriber — and must never be retried."""
+        from fedml_tpu.core.checkpoint import CheckpointWatcher, RoundCheckpointer
+
+        _args, model, params, ep = _build_endpoint()
+        ckpt = RoundCheckpointer(str(tmp_path))
+        self._save(ckpt, 0, params, 2.0)
+        self._save(ckpt, 1, params, 3.0)
+        # garbage every file of the newest step (torn write / killed
+        # trainer), keeping the step listed on disk
+        for p in glob.glob(str(tmp_path / "1" / "**" / "*"), recursive=True):
+            if os.path.isfile(p):
+                with open(p, "wb") as fh:
+                    fh.write(b"GARBAGE")
+        watcher = CheckpointWatcher(str(tmp_path))
+        step, state = watcher.poll()
+        assert step == 0
+        # the serving integration: published state swaps into the
+        # endpoint (and the swap is version-stamped, retrace-free)
+        ep.swap_from_checkpoint_state(state, version=step)
+        assert ep.version == 0 and ep.swaps == 1
+        x = np.zeros(8, np.float32)
+        got = np.asarray(model.apply(jax.tree.map(np.asarray, ep.params()), x[None]))
+        ref = np.asarray(
+            model.apply(jax.tree.map(lambda a: np.asarray(a) * 2.0, params), x[None])
+        )
+        assert np.allclose(got, ref, atol=1e-5)
+        assert watcher.poll() is None  # bad step 1 is never retried
+        ckpt.close()
+        watcher.close()
+
+    def test_close_stops_watch_threads(self, tmp_path):
+        from fedml_tpu.core.checkpoint import CheckpointWatcher
+
+        watcher = CheckpointWatcher(str(tmp_path), poll_interval_s=0.05)
+        thread = watcher.watch(lambda step, state: None)
+        assert thread.is_alive()
+        watcher.close()
+        thread.join(timeout=2.0)
+        assert not thread.is_alive()
+
+
+class TestServingTelemetry:
+    def test_histograms_expose_sum_count_and_bucket_lines(self):
+        """Satellite 4a: serving latency series export as full
+        Prometheus histograms — _bucket{le=...} lines (incl. +Inf)
+        plus _sum/_count."""
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import ServingEngine
+
+        args, _model, _params, ep = _build_endpoint()
+        with ServingEngine(ep, args) as eng:
+            _burst(eng, [np.zeros(8, np.float32)] * 3)
+        text = Telemetry.get_instance().prometheus_text()
+        assert "# TYPE serving_request_latency_s histogram" in text
+        assert 'serving_request_latency_s_bucket{' in text
+        assert 'le="+Inf"' in text
+        assert "serving_request_latency_s_sum" in text
+        assert "serving_request_latency_s_count" in text
+        # cumulative: the +Inf bucket equals the count
+        import re
+
+        inf = re.search(
+            r'serving_request_latency_s_bucket\{[^}]*le="\+Inf"[^}]*\} ([\d.]+)',
+            text,
+        )
+        cnt = re.search(
+            r"serving_request_latency_s_count\{[^}]*\} ([\d.]+)", text
+        )
+        assert inf and cnt and float(inf.group(1)) == float(cnt.group(1)) == 3.0
+        assert "serving_batch_occupancy" in text
+
+    def test_engine_spans_exported_with_matched_begin_end(self, tmp_path):
+        """Satellite 4b: serve.batch spans land in trace.json with
+        matched B/E events (the flight recorder's invariant)."""
+        from fedml_tpu.core.telemetry import Telemetry
+        from fedml_tpu.serving import ServingEngine
+
+        args, _model, _params, ep = _build_endpoint(
+            telemetry_dir=str(tmp_path)
+        )
+        with ServingEngine(ep, args) as eng:
+            for _ in range(3):
+                _burst(eng, [np.zeros(8, np.float32)] * 2)
+        tel = Telemetry.get_instance()
+        assert tel.export_run_artifacts(str(tmp_path))
+        with open(tmp_path / "trace.json") as fh:
+            events = json.load(fh)["traceEvents"]
+        begins = [e for e in events if e["name"] == "serve.batch" and e["ph"] == "B"]
+        ends = [e for e in events if e["name"] == "serve.batch" and e["ph"] == "E"]
+        assert len(begins) == len(ends) == 3
+        swaps = [e for e in events if e["name"] == "serve.jit_trace"]
+        assert len(swaps) == 1  # bucket 2 compiled once
+        # the prom exposition rode along
+        assert (tmp_path / "metrics.prom").exists()
+
+
+class TestCliServe:
+    def test_dry_run_builds_the_plane_and_reports(self, capsys):
+        from fedml_tpu import cli
+
+        rc = cli.main(["serve", "--dry-run"])
+        assert rc == 0
+        status = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert status["model"] == "lr"
+        assert status["backend"] == "LOCAL"
+        assert status["queue_size"] >= 1 and status["max_batch"] >= 1
+
+    def test_dry_run_restores_latest_checkpoint(self, tmp_path, capsys):
+        from fedml_tpu import cli, models
+        from fedml_tpu.core.checkpoint import RoundCheckpointer
+
+        args = make_args(dataset="synthetic", model="lr")
+        model = models.create(args, 10)
+        params = model.init(jax.random.PRNGKey(0))
+        ckpt = RoundCheckpointer(str(tmp_path))
+        ckpt.save(5, {"params": jax.tree.map(np.asarray, params), "round_idx": 5})
+        ckpt.close()
+        rc = cli.main(
+            ["serve", "--dry-run", "--checkpoint-dir", str(tmp_path)]
+        )
+        assert rc == 0
+        status = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert status["version"] == 5
+
+    def test_serve_knobs_validate(self):
+        with pytest.raises(ValueError, match="serve_queue_size"):
+            make_args(serve_queue_size=0)
+        with pytest.raises(ValueError, match="serve_bucket"):
+            make_args(serve_bucket="fib")
+        with pytest.raises(ValueError, match="serve_watch_interval_s"):
+            make_args(serve_watch_interval_s=-1)
+        a = make_args(serve_deadline_ms="250", serve_max_batch="32")
+        assert a.serve_deadline_ms == 250.0 and a.serve_max_batch == 32
+
+
+class TestHistogramBucketAdoption:
+    def test_buckets_attach_only_at_series_creation(self):
+        """A series that started bucket-less must stay a summary: late
+        bounds would leave earlier observations out of every finite
+        bucket while +Inf carries the full count — a non-cumulative
+        (invalid) Prometheus histogram."""
+        from fedml_tpu.core.telemetry import Telemetry
+
+        tel = Telemetry.get_instance()
+        tel.observe("late_buckets_s", 0.01)
+        tel.observe("late_buckets_s", 0.02, buckets=(0.05, 0.5))
+        text = tel.prometheus_text()
+        assert "# TYPE late_buckets_s summary" in text
+        assert "late_buckets_s_bucket" not in text
+        # and a bucketed-from-birth series keeps the invariant
+        tel.observe("born_bucketed_s", 0.01, buckets=(0.05, 0.5))
+        tel.observe("born_bucketed_s", 9.0, buckets=(0.05, 0.5))
+        text = tel.prometheus_text()
+        assert "# TYPE born_bucketed_s histogram" in text
