@@ -80,7 +80,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
-    "telemetry", "serving",
+    "telemetry", "serving", "chaos",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -966,6 +966,260 @@ def run_serving(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
+    """Chaos phase (docs/robustness.md): a LOCAL cross-silo world under
+    combined drop/dup/delay faults with the full fault-tolerance layer
+    on (``reliable_comm`` + heartbeats + round WAL), plus one mid-run
+    client kill (replaced — the server RESYNCs the replacement into the
+    pending round) and one server crash + restart (resumes from its
+    checkpoint/WAL). Asserts the run completes, every client upload is
+    aggregated EXACTLY once per round (telemetry counters), and the
+    final params are bit-identical to a fault-free run of the same
+    seed — the cohort is preserved through both failures, so identity
+    must hold.
+
+    ``smoke`` (CI gate): 3 clients x 4 rounds on the LR mini cohort —
+    the same kill + restart choreography in seconds."""
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import constants as C
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.data import load
+
+    n_clients = 3 if (smoke or on_cpu) else 4
+    rounds = 4 if (smoke or on_cpu) else 6
+    train_size = 240 if smoke else 400
+    chaos_kw = dict(
+        reliable_comm=True,
+        comm_retry_max=8,
+        comm_retry_base_s=0.05,
+        heartbeat_interval_s=0.1,
+        # generous: deaths in this phase are healed by restarts, not
+        # declared (declaration is covered by tests/test_robustness.py)
+        heartbeat_timeout_s=60.0,
+        checkpoint_freq=1,
+        fault_injection={
+            "drop_prob": 0.3,
+            "duplicate_prob": 0.2,
+            "delay_s": 0.05,
+            "delay_prob": 0.1,
+        },
+    )
+
+    def mk(rank, run_id, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = train_size
+        a.synthetic_test_size = 60
+        a.model = "lr"
+        a.partition_method = "hetero"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 1
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def build_world(run_id, **kw):
+        a0, ds0, m0 = mk(0, run_id, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, n_clients + 1):
+            a, ds, m = mk(r, run_id, **kw)
+            clients.append(Client(a, None, ds, m))
+        return server, clients
+
+    def join_all(threads, note):
+        for t in threads:
+            t.join(timeout=120)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(f"{note}: threads hung: {hung}")
+
+    # -- fault-free reference run -------------------------------------
+    Telemetry.reset()
+    server, clients = build_world("bench_chaos_clean")
+    threads = [
+        threading.Thread(target=c.run, daemon=True, name=f"clean-c{i}")
+        for i, c in enumerate(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    server.run()
+    join_all(threads, "clean world")
+    clean_dt = time.perf_counter() - t0
+    clean_params = jax.tree.map(
+        np.asarray, server.aggregator.get_global_model_params()
+    )
+    _progress(f"chaos: clean world done in {clean_dt:.1f}s")
+
+    # -- chaos run ----------------------------------------------------
+    class _ChaosKill(Exception):
+        pass
+
+    class _ChaosCrash(Exception):
+        pass
+
+    Telemetry.reset()
+    ckpt_dir = _tempfile.mkdtemp(prefix="bench_chaos_ck_")
+    chaos_kw["checkpoint_dir"] = ckpt_dir
+    server1, cclients = build_world("bench_chaos", **chaos_kw)
+
+    # client kill: rank 2's handler dies (kill -9 analog: the exception
+    # tears down its receive loop AND we stop its beat thread) instead
+    # of training round 1; a replacement with the same rank reconnects
+    killed = threading.Event()
+    victim = cclients[1]
+    orig_tas = victim.manager._train_and_send
+
+    def kill_or_train(msg):
+        if (
+            int(msg.get(C.MSG_ARG_KEY_ROUND_INDEX, 0)) == 1
+            and not killed.is_set()
+        ):
+            if victim.manager._heartbeat is not None:
+                victim.manager._heartbeat.stop()
+            killed.set()
+            raise _ChaosKill()
+        orig_tas(msg)
+
+    victim.manager._train_and_send = kill_or_train
+
+    # server crash: after round rounds-2 fully closes (next broadcast
+    # out, checkpoint + WAL written, metrics reported) the dispatch
+    # thread dies; a fresh server restores from the checkpoint dir and
+    # the clients' heartbeats re-announce them to it
+    crashed = threading.Event()
+    mgr1 = server1.manager
+    orig_report = mgr1._report_round
+
+    def report_then_crash(eval_round, cohort, n_aggregated):
+        orig_report(eval_round, cohort, n_aggregated)
+        if eval_round == rounds - 2 and not crashed.is_set():
+            if mgr1._failure_detector is not None:
+                mgr1._failure_detector.stop()
+            crashed.set()
+            raise _ChaosCrash()
+
+    mgr1._report_round = report_then_crash
+
+    def client_thread(c):
+        try:
+            c.run()
+        except _ChaosKill:
+            pass
+
+    cthreads = [
+        threading.Thread(
+            target=client_thread, args=(c,), daemon=True, name=f"chaos-c{i}"
+        )
+        for i, c in enumerate(cclients)
+    ]
+    t0 = time.perf_counter()
+    for t in cthreads:
+        t.start()
+
+    def server_thread():
+        try:
+            server1.run()
+        except _ChaosCrash:
+            pass
+
+    st = threading.Thread(target=server_thread, daemon=True, name="chaos-srv1")
+    st.start()
+
+    if not killed.wait(timeout=180):
+        raise RuntimeError("chaos: client kill never triggered")
+    a, ds, m = mk(2, "bench_chaos", **chaos_kw)
+    replacement = Client(a, None, ds, m)
+    rthread = threading.Thread(
+        target=replacement.run, daemon=True, name="chaos-c-replacement"
+    )
+    rthread.start()
+    _progress("chaos: client killed and replacement started")
+
+    if not crashed.wait(timeout=180):
+        raise RuntimeError("chaos: server crash never triggered")
+    st.join(timeout=120)
+    _progress("chaos: server crashed; restarting from checkpoint")
+    a0b, ds0b, m0b = mk(0, "bench_chaos", **chaos_kw)
+    server2 = Server(a0b, None, ds0b, m0b)
+    resumed_at = server2.manager.round_idx
+    server2.run()
+    join_all(cthreads + [rthread], "chaos world")
+    chaos_dt = time.perf_counter() - t0
+
+    tel = Telemetry.get_instance()
+
+    def total(counter):
+        return sum(tel.counters_matching(counter).values())
+
+    aggregated = total("cross_silo_clients_aggregated_total")
+    expected = rounds * n_clients
+    diff = max(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda x, y: float(np.max(np.abs(np.asarray(x) - y))),
+                server2.aggregator.get_global_model_params(),
+                clean_params,
+            )
+        )
+    )
+    out = {
+        "device": str(jax.devices()[0]),
+        "clients": n_clients,
+        "rounds": rounds,
+        "clean_rounds_per_sec": round(rounds / clean_dt, 4),
+        "chaos_rounds_per_sec": round(rounds / chaos_dt, 4),
+        "slowdown_vs_clean": round(chaos_dt / max(clean_dt, 1e-9), 3),
+        "faults_injected": total("comm_faults_injected_total"),
+        "retries_total": total("comm_retries_total"),
+        "dup_dropped_total": total("comm_dup_dropped_total"),
+        "giveups_total": total("comm_giveups_total"),
+        "resyncs_total": total("cross_silo_resyncs_total"),
+        "client_killed": killed.is_set(),
+        "server_restarted": crashed.is_set(),
+        "server_resumed_at_round": resumed_at,
+        "rounds_completed": server2.manager.round_idx,
+        "wal_records": len(server2.manager._wal.records()),
+        "uploads_aggregated": aggregated,
+        "expected_uploads": expected,
+        "exactly_once": aggregated == expected,
+        "max_abs_diff_vs_clean": diff,
+        "params_match_clean": diff == 0.0,
+    }
+    _progress(
+        f"chaos: {out['rounds_completed']}/{rounds} rounds, "
+        f"{aggregated:.0f}/{expected} uploads aggregated, "
+        f"max_abs_diff {diff:g}"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -1064,6 +1318,9 @@ _PIPELINE_TIMEOUT_S = 300.0
 # same jitted fns
 _TELEMETRY_TIMEOUT_S = 240.0
 _SERVING_TIMEOUT_S = 180.0
+# two LOCAL worlds (clean + chaos) with a kill and a server restart;
+# dominated by jit compiles on a cold 1-core box
+_CHAOS_TIMEOUT_S = 300.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1329,6 +1586,11 @@ def _main_guarded() -> None:
     # latency + req/s per bucket, one jit trace per bucket across
     # hot-swaps, bounded-queue shedding
     _run_demoted_phase("serving", _SERVING_TIMEOUT_S)
+    # chaos phase (fault-tolerance layer): a LOCAL world under
+    # drop/dup/delay faults + client kill + server restart must
+    # complete with exactly-once aggregation and clean-run-identical
+    # params — robustness as a measured contract
+    _run_demoted_phase("chaos", _CHAOS_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -1468,6 +1730,8 @@ def _phase_main(argv) -> None:
         out = run_telemetry(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "serving":
         out = run_serving(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "chaos":
+        out = run_chaos(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -33,3 +33,13 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_serving_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Chaos smoke (3 clients x 4 rounds, drop/dup/delay faults + one client
+# kill + one server restart, CPU): the fault-tolerance layer must run
+# end-to-end through bench.py's chaos phase child and emit the
+# detail.chaos contract keys — run completes, every upload aggregated
+# exactly once (telemetry counters), final params identical to a
+# fault-free run of the same seed.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_chaos_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

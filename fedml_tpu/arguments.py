@@ -103,6 +103,32 @@ _DEFAULTS: Dict[str, Any] = {
     # mapping of {drop_prob, duplicate_prob, delay_s, delay_prob, seed,
     # msg_types, max_faults}; None disables
     "fault_injection": None,
+    # reliable delivery (core/comm/reliable.py): wrap every comm
+    # endpoint in an ack/retransmit channel with receive-side dedup —
+    # effectively exactly-once delivery over a lossy network. Enable on
+    # ALL processes of a world together.
+    "reliable_comm": False,
+    # reliable channel: how many retransmits before a send is given up
+    # (the product of the backoff series is the channel's send timeout)
+    "comm_retry_max": 5,
+    # first-retry backoff; doubles per attempt with up to +50% jitter
+    "comm_retry_base_s": 0.2,
+    # per-attempt deadline of one gRPC unary send (the seed's fixed
+    # timeout=300); the transport retries transient RPC errors a small
+    # fixed number of times (deliberately NOT comm_retry_max — the
+    # reliable channel's retransmits call back into this send, and
+    # sharing the knob would multiply the budgets), then raises a typed
+    # CommSendError instead of whatever grpc surfaces
+    "grpc_send_timeout_s": 300.0,
+    # client liveness beats (core/comm/heartbeat.py): emit
+    # MSG_TYPE_C2S_HEARTBEAT this often; the beats double as the
+    # reconnect probe after a server restart. 0 disables
+    "heartbeat_interval_s": 0.0,
+    # server failure detector: declare a client dead after this long
+    # with NO traffic (beats, uploads, status) and fold it into the
+    # OFFLINE/deadline-cohort paths so a kill -9'd client can never
+    # stall a round. Use 3-5x heartbeat_interval_s. 0 disables
+    "heartbeat_timeout_s": 0.0,
     # robustness (reference: fedavg_robust example config)
     "defense_type": None,
     "norm_bound": 5.0,
@@ -295,6 +321,7 @@ class Arguments:
             "pipeline_depth",
             "serve_queue_size",
             "serve_max_batch",
+            "comm_retry_max",
         ):
             setattr(self, int_key, int(getattr(self, int_key)))
         if getattr(self, "pipeline_depth", 1) < 1:
@@ -322,8 +349,28 @@ class Arguments:
             "serve_batch_wait_ms",
             "serve_deadline_ms",
             "serve_watch_interval_s",
+            "comm_retry_base_s",
+            "grpc_send_timeout_s",
+            "heartbeat_interval_s",
+            "heartbeat_timeout_s",
         ):
             setattr(self, float_key, float(getattr(self, float_key)))
+        if self.comm_retry_max < 0:
+            raise ValueError(
+                f"comm_retry_max={self.comm_retry_max}: must be >= 0 "
+                "(0 = no retransmits/retries)"
+            )
+        for nonneg_key in (
+            "comm_retry_base_s", "heartbeat_interval_s", "heartbeat_timeout_s",
+        ):
+            if getattr(self, nonneg_key) < 0:
+                raise ValueError(
+                    f"{nonneg_key}={getattr(self, nonneg_key)}: must be >= 0"
+                )
+        if self.grpc_send_timeout_s <= 0:
+            raise ValueError(
+                f"grpc_send_timeout_s={self.grpc_send_timeout_s}: must be > 0"
+            )
         if self.serve_queue_size < 1 or self.serve_max_batch < 1:
             raise ValueError(
                 f"serve_queue_size={self.serve_queue_size} / "

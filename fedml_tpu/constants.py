@@ -56,6 +56,16 @@ MSG_TYPE_S2C_FINISH = 7
 MSG_TYPE_C2S_FINISH_ACK = 8
 MSG_TYPE_CONNECTION_IS_READY = 0
 
+# Liveness + crash-recovery protocol (core/comm/heartbeat.py and the
+# cross-silo managers — beyond the reference, which has no failure
+# detection): clients emit periodic HEARTBEATs; a server that misses
+# them past heartbeat_timeout_s declares the client dead. RESYNC is the
+# reconnect downlink — current round + params + silo assignment — sent
+# to a client that (re)appears mid-federation or after a server
+# restart, instead of a stale round-0 init.
+MSG_TYPE_C2S_HEARTBEAT = 9
+MSG_TYPE_S2C_RESYNC = 10
+
 MSG_ARG_KEY_TYPE = "msg_type"
 MSG_ARG_KEY_SENDER = "sender"
 MSG_ARG_KEY_RECEIVER = "receiver"
@@ -83,9 +93,26 @@ MSG_TYPE_SILO_FINISH = 21
 # server-internal: aggregation deadline fired (straggler handling —
 # beyond the reference, which always waits for every client)
 MSG_TYPE_S2S_AGG_DEADLINE = 30
+# server-internal: the failure detector declared a client dead (posted
+# to the server's own inbox so membership mutation stays on the single
+# dispatch thread, same pattern as the deadline loopback)
+MSG_TYPE_S2S_CLIENT_DEAD = 31
 
 # Serving plane (fedml_tpu/serving — beyond the reference, which ships
 # trained models to an external MLOps tier): one request/response pair
 # over any comm backend; the payload keys live on the frontends.
 MSG_TYPE_C2S_INFER_REQUEST = 40
 MSG_TYPE_S2C_INFER_RESPONSE = 41
+
+# Reliable-delivery channel (core/comm/reliable.py): comm-layer ACKs
+# that never reach application handlers — the channel consumes them.
+# Tracked messages carry (channel-id, sequence) in their params; the
+# ACK echoes both so a restarted process's fresh channel id can never
+# collide with its previous incarnation's sequence space.
+MSG_TYPE_COMM_ACK = 50
+MSG_ARG_KEY_COMM_SEQ = "comm_seq"
+MSG_ARG_KEY_COMM_CHAN = "comm_chan"
+MSG_ARG_KEY_COMM_ACK_SEQ = "comm_ack_seq"
+MSG_ARG_KEY_COMM_ACK_CHAN = "comm_ack_chan"
+# failure-detector internals: which rank was declared dead
+MSG_ARG_KEY_RANK = "rank"

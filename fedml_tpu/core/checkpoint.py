@@ -10,6 +10,7 @@ resume.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -108,6 +109,94 @@ class RoundCheckpointer:
 
     def close(self) -> None:
         self.manager.close()
+
+
+class RoundWAL:
+    """Append-only write-ahead log of COMPLETED federation rounds.
+
+    One JSONL record per completed round next to the orbax steps:
+    ``{"round_idx", "ckpt_step", "cohort"}`` — which round finished,
+    which checkpoint step (if any) carries its aggregated params, and
+    which client ranks the round was broadcast to. The orbax checkpoint
+    holds the heavy state (params); the WAL holds the narrative a
+    restarted server needs to know WHERE it is:
+
+    - ``last()`` after a crash names the last round that actually
+      completed; when ``checkpoint_freq > 1`` that can be AHEAD of the
+      newest restorable checkpoint, and the gap (rounds whose
+      aggregates were lost with the process) is detected and logged
+      loudly instead of silently retraining;
+    - the cohort record makes post-mortems concrete ("round 41 was
+      waiting on ranks {2,5} when the server died").
+
+    Durability: each append is one ``write + flush + fsync``; ``last``
+    / ``records`` tolerate a torn final line (a server killed
+    mid-append is a normal event this log exists for).
+    """
+
+    FILENAME = "round_wal.jsonl"
+
+    def __init__(self, checkpoint_dir: str) -> None:
+        self.dir = os.path.abspath(checkpoint_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, self.FILENAME)
+        # only the FIRST append of a process can find a torn tail (our
+        # own appends always end in a newline); probe once, lazily
+        self._tail_checked = False
+
+    def append(
+        self,
+        round_idx: int,
+        ckpt_step: Optional[int],
+        cohort: List[int],
+    ) -> None:
+        rec = {
+            "round_idx": int(round_idx),
+            "ckpt_step": None if ckpt_step is None else int(ckpt_step),
+            "cohort": sorted(int(r) for r in cohort),
+        }
+        # a previous crash mid-append can leave a torn, newline-less
+        # final line; start fresh so the new record never concatenates
+        # onto it (the torn fragment stays skippable on read)
+        torn_tail = False
+        if not self._tail_checked:
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        torn_tail = f.read(1) != b"\n"
+            except FileNotFoundError:
+                pass
+        with open(self.path, "a") as f:
+            f.write(("\n" if torn_tail else "") + json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._tail_checked = True
+
+    def records(self) -> List[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return []
+        out: List[Dict[str, Any]] = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    # torn write from a mid-append crash: everything
+                    # before it is intact and that's what matters
+                    logging.warning(
+                        "round WAL %s: skipping torn record %r",
+                        self.path, line[:80],
+                    )
+        return out
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        recs = self.records()
+        return recs[-1] if recs else None
 
 
 class CheckpointWatcher:

@@ -75,6 +75,11 @@ class FaultInjector(BaseCommunicationManager):
         self.max_faults = max_faults if max_faults is None else int(max_faults)
         self.injected = {"drop": 0, "duplicate": 0, "delay": 0}
         self._timers = []
+        # set by stop_receive_message(): Timer.cancel() only stops
+        # timers that have not FIRED yet — a delay timer already past
+        # cancel() when the world tears down would deliver into a
+        # stopped transport (late sends after FINISH racing teardown)
+        self.closed = False
 
     def _note_fault(self, kind: str, msg_type: int) -> None:
         """Count the injection locally AND in the process-wide telemetry
@@ -135,7 +140,8 @@ class FaultInjector(BaseCommunicationManager):
                     # its Message (full model params), so an append-only
                     # list grows by one payload per injected delay
                     try:
-                        self.inner.send_message(msg)
+                        if not self.closed:
+                            self.inner.send_message(msg)
                     finally:
                         try:
                             self._timers.remove(t_ref[0])
@@ -161,6 +167,7 @@ class FaultInjector(BaseCommunicationManager):
         self.inner.handle_receive_message()
 
     def stop_receive_message(self) -> None:
+        self.closed = True  # a fired-but-not-delivered timer must no-op
         # snapshot: firing timers remove themselves from self._timers,
         # and mutating the list mid-iteration can skip a cancel
         for t in list(self._timers):

@@ -20,18 +20,39 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from concurrent import futures
 from typing import Dict, List, Optional
 
 import grpc
 
 from ..message import Message
-from .base import BaseCommunicationManager, Observer
+from .base import (
+    BaseCommunicationManager,
+    CommSendError,
+    Observer,
+    backoff_delay_s,
+)
 
 _SERVICE = "fedml_tpu.Comm"
 _METHOD = "Send"
 _MAX_MSG = 1000 * 1024 * 1024  # 1000 MB, matching grpc_comm_manager.py:41-45
 _STOP = object()
+
+# status codes a second attempt can plausibly fix; everything else
+# (INVALID_ARGUMENT, UNIMPLEMENTED, RESOURCE_EXHAUSTED from an
+# oversized payload, ...) fails identically every time and surfaces
+# as CommSendError immediately
+_TRANSIENT_CODES = frozenset(
+    (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.ABORTED,
+        grpc.StatusCode.INTERNAL,
+        grpc.StatusCode.UNKNOWN,
+        grpc.StatusCode.CANCELLED,
+    )
+)
 
 
 def _ident(b: bytes) -> bytes:
@@ -46,10 +67,16 @@ class GrpcCommunicationManager(BaseCommunicationManager):
         ip_config: Optional[Dict[int, str]] = None,
         port_base: int = 8890,
         host: str = "0.0.0.0",
+        send_timeout_s: float = 300.0,
+        send_retries: int = 2,
+        retry_base_s: float = 0.2,
     ) -> None:
         self.rank = int(rank)
         self.size = int(size)
         self.port_base = int(port_base)
+        self.send_timeout_s = float(send_timeout_s)
+        self.send_retries = int(send_retries)
+        self.retry_base_s = float(retry_base_s)
         self.ip_config = ip_config or {r: "127.0.0.1" for r in range(size)}
         self._observers: List[Observer] = []
         self._q: "queue.Queue" = queue.Queue()
@@ -109,8 +136,51 @@ class GrpcCommunicationManager(BaseCommunicationManager):
             return self._stubs[rank]
 
     def send_message(self, msg: Message) -> None:
+        """One unary RPC, retried with jittered exponential backoff.
+
+        The seed's single ``timeout=300`` blocking call made any
+        transient gRPC error (peer restarting, LB blip, deadline on a
+        slow link) fatal to the round loop. Each attempt gets
+        ``send_timeout_s`` (``grpc_send_timeout_s`` knob); after
+        ``send_retries`` retries the typed :class:`CommSendError` is
+        raised — and counted — instead of whatever grpc surfaces.
+        """
         receiver = int(msg.get_receiver_id())
-        self._stub(receiver)(msg.to_bytes(), wait_for_ready=True, timeout=300)
+        data = msg.to_bytes()  # serialize once across attempts
+        attempts = self.send_retries + 1
+        last_err: Optional[Exception] = None
+        attempts_made = 0
+        for attempt in range(attempts):
+            try:
+                attempts_made += 1
+                self._stub(receiver)(
+                    data, wait_for_ready=True, timeout=self.send_timeout_s
+                )
+                return
+            except grpc.RpcError as e:
+                last_err = e
+                code = e.code() if hasattr(e, "code") else None
+                if code not in _TRANSIENT_CODES:
+                    break  # permanent: retrying burns time, not errors
+                if attempt + 1 < attempts:
+                    delay = backoff_delay_s(attempt, self.retry_base_s)
+                    logging.warning(
+                        "grpc send to rank %d failed (%s, attempt %d/%d); "
+                        "retrying in %.2fs",
+                        receiver,
+                        getattr(e, "code", lambda: e)(),
+                        attempt + 1, attempts, delay,
+                    )
+                    self._count_send_event("comm_transport_retries_total", msg)
+                    time.sleep(delay)
+        self._count_send_event("comm_send_errors_total", msg)
+        raise CommSendError(receiver, attempts_made, last_err)
+
+    @staticmethod
+    def _count_send_event(counter: str, msg: Message) -> None:
+        from ..telemetry import Telemetry
+
+        Telemetry.get_instance().inc(counter, msg_type=int(msg.get_type()))
 
     # -- observer loop -------------------------------------------------
     def add_observer(self, observer: Observer) -> None:

@@ -27,11 +27,17 @@ def _build_com_manager(
         fabric = f"run_{getattr(args, 'run_id', '0')}"
         return LocalCommunicationManager(fabric, rank, size)
     if backend == constants.COMM_BACKEND_GRPC:
+        # NOTE: the transport's per-RPC retry budget deliberately stays
+        # the class default (small, fixed) rather than comm_retry_max —
+        # with reliable_comm the channel's retransmits call back into
+        # this send, and wiring the same knob into both layers would
+        # multiply the budgets (retry_max^2 RPCs per give-up)
         return build_grpc_manager(
             rank,
             size,
             ipconfig_path=getattr(args, "grpc_ipconfig_path", None),
             port_base=int(getattr(args, "grpc_port_base", 8890)),
+            send_timeout_s=float(getattr(args, "grpc_send_timeout_s", 300.0)),
         )
     if backend == constants.COMM_BACKEND_TRPC:
         from .comm.tensor_rpc import TensorRpcCommunicationManager
@@ -73,7 +79,13 @@ def _build_com_manager(
 
 
 def build_grpc_manager(
-    rank: int, size: int, ipconfig_path: Optional[str], port_base: int
+    rank: int,
+    size: int,
+    ipconfig_path: Optional[str],
+    port_base: int,
+    send_timeout_s: float = 300.0,
+    send_retries: int = 2,
+    retry_base_s: float = 0.2,
 ):
     """Shared gRPC endpoint builder — used for the FL world and for
     silo control fabrics (cross_silo/hierarchical)."""
@@ -81,7 +93,13 @@ def build_grpc_manager(
 
     ip_config = _load_ip_config(ipconfig_path) if ipconfig_path else None
     return GrpcCommunicationManager(
-        rank=rank, size=size, ip_config=ip_config, port_base=port_base
+        rank=rank,
+        size=size,
+        ip_config=ip_config,
+        port_base=port_base,
+        send_timeout_s=send_timeout_s,
+        send_retries=send_retries,
+        retry_base_s=retry_base_s,
     )
 
 
@@ -116,15 +134,23 @@ class _ManagerBase(Observer):
         )
         from .comm.faults import maybe_wrap_faulty
         from .comm.instrument import wrap_instrumented
+        from .comm.reliable import maybe_wrap_reliable
         from .telemetry import Telemetry
 
         # telemetry counting sits INSIDE fault injection: the counters
         # record actual wire traffic (a dropped message never left, a
         # duplicated one left twice); injections themselves are counted
-        # by the FaultInjector (comm_faults_injected_total)
+        # by the FaultInjector (comm_faults_injected_total). The
+        # reliable channel sits OUTSIDE both: its retransmissions must
+        # re-traverse the fault injector (an injected drop is exactly
+        # the lossy link a retry recovers) and be counted as the wire
+        # traffic they are.
         self.telemetry = Telemetry.get_instance(args)
-        self.com_manager = maybe_wrap_faulty(
-            wrap_instrumented(self.com_manager, args), args
+        self.com_manager = maybe_wrap_reliable(
+            maybe_wrap_faulty(
+                wrap_instrumented(self.com_manager, args), args
+            ),
+            args,
         )
         self.com_manager.add_observer(self)
         self.message_handler_dict: Dict[int, Callable[[Message], None]] = {}

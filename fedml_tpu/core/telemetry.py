@@ -31,6 +31,15 @@ device values exclusively through the existing ``DeferredMetrics``
 flush; it never adds a device fetch, so ``host_syncs_per_round`` is
 bit-identical with telemetry on or off (asserted by the bench
 ``detail.telemetry`` phase and tests/test_telemetry.py).
+
+Robustness-layer vocabulary (docs/robustness.md): the reliable channel
+counts ``comm_retries_total`` / ``comm_dup_dropped_total`` /
+``comm_giveups_total`` (core/comm/reliable.py), the gRPC transport
+``comm_transport_retries_total`` / ``comm_send_errors_total``
+(core/comm/grpc_backend.py), and the cross-silo server
+``cross_silo_clients_declared_dead_total`` /
+``cross_silo_resyncs_total`` — all tagged by ``msg_type`` where it
+exists, all exactly-once evidence the chaos bench asserts against.
 """
 
 from __future__ import annotations

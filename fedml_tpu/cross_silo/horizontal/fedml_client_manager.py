@@ -112,6 +112,13 @@ class FedMLClientManager(ClientManager):
         # shared flight-recorder timeline + per-round progress marks
         # for the stall watchdog (self.telemetry from _ManagerBase)
         self.telemetry.attach_profiler(self.profiler)
+        # liveness beats (core/comm/heartbeat.py): started once the
+        # connection is up; they feed the server's failure detector and
+        # double as the reconnect probe after a server restart
+        self._heartbeat = None
+        self._heartbeat_interval_s = float(
+            getattr(args, "heartbeat_interval_s", 0.0) or 0.0
+        )
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -125,12 +132,28 @@ class FedMLClientManager(ClientManager):
             self.handle_message_receive_model_from_server,
         )
         self.register_message_receive_handler(
+            constants.MSG_TYPE_S2C_RESYNC, self.handle_message_resync
+        )
+        self.register_message_receive_handler(
             constants.MSG_TYPE_S2C_FINISH, self.handle_message_finish
         )
 
     # -- handlers (fedml_client_manager.py:49-130) --------------------
     def handle_connection_ready(self, msg: Message) -> None:
         self.send_client_status(self.server_rank)
+        if self._heartbeat_interval_s > 0 and self._heartbeat is None:
+            from ...core.comm.heartbeat import HeartbeatEmitter
+
+            self._heartbeat = HeartbeatEmitter(
+                self._send_heartbeat, self._heartbeat_interval_s
+            ).start()
+
+    def _send_heartbeat(self) -> None:
+        # a fresh Message per beat: the LOCAL fabric passes objects by
+        # reference, so a reused envelope would alias in-flight beats
+        self.send_message(
+            Message(constants.MSG_TYPE_C2S_HEARTBEAT, self.rank, self.server_rank)
+        )
 
     def send_client_status(self, receiver_id: int) -> None:
         msg = Message(constants.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, receiver_id)
@@ -158,11 +181,25 @@ class FedMLClientManager(ClientManager):
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
         self._train_and_send(msg)
 
+    def handle_message_resync(self, msg: Message) -> None:
+        """Crash-recovery downlink: the server (restarted, or seeing
+        this client reconnect) ships the CURRENT round + params instead
+        of a stale init — train it like any sync."""
+        logging.info(
+            "client rank %d: RESYNC to round %s",
+            self.rank, msg.get(constants.MSG_ARG_KEY_ROUND_INDEX),
+        )
+        self.telemetry.inc("cross_silo_client_resyncs_total")
+        self._train_and_send(msg)
+
     def handle_message_finish(self, msg: Message) -> None:
         logging.info("client rank %d: finish", self.rank)
         self.finish()
 
     def finish(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         # client-side telemetry (spans, comm counters) must survive the
         # process: rank-suffixed artifacts next to the server's
         self.telemetry.export_run_artifacts(

@@ -32,6 +32,27 @@ handles OFFLINE leaves mid-round (the leaver's slot is dropped from
 the round's expected set so the federation never stalls on it). The
 reference blocks round 0 until every configured client appears and has
 no membership changes after that (fedml_server_manager.py:95-119).
+
+**Beyond the reference — failure detection**: a client killed WITHOUT
+sending OFFLINE (kill -9) stalls any non-deadline world forever. With
+``args.heartbeat_timeout_s`` the server runs a ``FailureDetector``
+(core/comm/heartbeat.py): any traffic from a rank counts as liveness
+(clients additionally beat every ``heartbeat_interval_s``), and a rank
+silent past the timeout is declared dead via a self-addressed
+``MSG_TYPE_S2S_CLIENT_DEAD`` message — all membership mutation stays
+on the dispatch thread — which folds into the same drop-expected path
+as an OFFLINE leave, so the round completes over the survivors.
+
+**Beyond the reference — crash recovery**: with ``checkpoint_dir`` the
+server keeps a ``RoundWAL`` (round idx + checkpoint step + sampled
+cohort per completed round) next to its orbax checkpoints. A restarted
+server restores the newest checkpoint, cross-checks the WAL (loudly
+reporting rounds lost to ``checkpoint_freq > 1``), and releases
+reconnecting clients with ``MSG_TYPE_S2C_RESYNC`` — current round +
+params — instead of a stale round-0 init. Client heartbeats double as
+the reconnect probe: a beat or ONLINE from a rank the server doesn't
+know (it just restarted) re-registers that rank, and a rank that
+reappears mid-round is resynced into its still-pending assignment.
 """
 
 from __future__ import annotations
@@ -118,6 +139,21 @@ class FedMLServerManager(ServerManager):
             )
         self.joins = 0
         self.leaves = 0
+        # failure detector (core/comm/heartbeat.py): declared-dead
+        # ranks are excluded from broadcasts until they reconnect
+        self.deaths = 0
+        self._dead_ranks = set()
+        # rank -> silo index of the CURRENT round's broadcast; the
+        # reconnect path resyncs a reappearing rank into its pending slot
+        self._round_assignment: Dict[int, int] = {}
+        self._failure_detector = None
+        timeout_s = float(getattr(args, "heartbeat_timeout_s", 0.0) or 0.0)
+        if timeout_s > 0:
+            from ...core.comm.heartbeat import FailureDetector
+
+            self._failure_detector = FailureDetector(
+                timeout_s, self._post_client_dead
+            ).start()
         from ...core.compression import make_codec
 
         # compressed-uplink decode (core/compression.py): clients ship
@@ -130,11 +166,14 @@ class FedMLServerManager(ServerManager):
         # Clients are stateless between rounds (they receive the model
         # with every broadcast), so server-side state is sufficient.
         self._ckpt = None
+        self._wal = None
+        self._resumed = False
         ckpt_dir = getattr(args, "checkpoint_dir", None)
         if ckpt_dir:
-            from ...core.checkpoint import RoundCheckpointer
+            from ...core.checkpoint import RoundCheckpointer, RoundWAL
 
             self._ckpt = RoundCheckpointer(ckpt_dir)
+            self._wal = RoundWAL(ckpt_dir)
             self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
             state = self._ckpt.restore()
             if state is not None:
@@ -151,10 +190,25 @@ class FedMLServerManager(ServerManager):
                 self.aggregator._agg_round = int(
                     state.get("agg_round", self.round_idx)
                 )
+                self._resumed = True
                 logging.info(
                     "cross-silo server resumed at round %d from %s",
                     self.round_idx, ckpt_dir,
                 )
+                # WAL cross-check: with checkpoint_freq > 1 the last
+                # COMPLETED round can be ahead of the newest restorable
+                # params — those rounds retrain after the restart; say
+                # so loudly instead of silently repeating work
+                last = self._wal.last()
+                if last is not None and int(last["round_idx"]) + 1 > self.round_idx:
+                    logging.warning(
+                        "round WAL shows round %d completed but newest "
+                        "checkpoint resumes at round %d — %d round(s) "
+                        "will retrain (checkpoint_freq=%d)",
+                        int(last["round_idx"]), self.round_idx,
+                        int(last["round_idx"]) + 1 - self.round_idx,
+                        self._ckpt_freq,
+                    )
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -170,6 +224,23 @@ class FedMLServerManager(ServerManager):
             constants.MSG_TYPE_S2S_AGG_DEADLINE,
             self.handle_message_deadline,
         )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_C2S_HEARTBEAT,
+            self.handle_message_heartbeat,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2S_CLIENT_DEAD,
+            self.handle_message_client_dead,
+        )
+
+    def receive_message(self, msg_type: int, msg_params: Message) -> None:
+        # ANY inbound traffic proves the sender alive — uploads and
+        # status changes carry liveness as well as heartbeats do
+        if self._failure_detector is not None:
+            sender = int(msg_params.get_sender_id())
+            if sender != self.rank:
+                self._failure_detector.note_alive(sender)
+        super().receive_message(msg_type, msg_params)
 
     def _active_ranks(self):
         return [r for r, on in sorted(self.client_online_status.items()) if on]
@@ -200,15 +271,26 @@ class FedMLServerManager(ServerManager):
                 for r in range(len(self.client_real_ids) + 1, sender + 1):
                     self.client_real_ids.append(r)
                     self._rank_of_real_id[r] = r
+            was_online = self.client_online_status.get(sender, False)
             self.client_online_status[sender] = True
+            self._dead_ranks.discard(sender)
+            if self._failure_detector is not None:
+                self._failure_detector.watch(sender)
             if self.is_initialized:
-                if self.elastic:
+                if self.elastic and not was_online:
                     self.joins += 1
                     logging.info(
                         "elastic join: rank %d online at round %d "
                         "(participates from the next broadcast)",
                         sender, self.round_idx,
                     )
+                # resync regardless of was_online: a kill -9'd client's
+                # replacement re-announces ONLINE while the server may
+                # not yet have noticed the death — if its slot in the
+                # current round is still pending, ship it the round
+                # (re-training a slot whose upload later turns out to
+                # have landed is idempotent by design)
+                self._maybe_resync(sender)
                 return
             if self.elastic:
                 ready = len(self._active_ranks()) >= int(
@@ -226,7 +308,11 @@ class FedMLServerManager(ServerManager):
             if not self.elastic:
                 logging.warning("OFFLINE from rank %d ignored (non-elastic)", sender)
                 return
+            if not self.client_online_status.get(sender, False):
+                return  # duplicated/stale OFFLINE: already gone, count once
             self.client_online_status[sender] = False
+            if self._failure_detector is not None:
+                self._failure_detector.unwatch(sender)
             self.leaves += 1
             logging.info(
                 "elastic leave: rank %d offline at round %d", sender, self.round_idx
@@ -235,6 +321,119 @@ class FedMLServerManager(ServerManager):
                 # the round was only waiting on the leaver
                 if self.aggregator.check_whether_all_receive():
                     self._finish_round()
+
+    # -- liveness / failure detection (beyond the reference) ----------
+    def handle_message_heartbeat(self, msg: Message) -> None:
+        """A beat from an unknown-or-offline rank is an implicit ONLINE:
+        after a server restart the clients' ONLINE messages are long
+        gone, and their periodic beats are what re-announces presence
+        (liveness itself was already noted in ``receive_message``)."""
+        sender = int(msg.get_sender_id())
+        if not self.client_online_status.get(sender, False):
+            synth = Message(
+                constants.MSG_TYPE_C2S_CLIENT_STATUS, sender, self.rank
+            )
+            synth.add_params(
+                constants.MSG_ARG_KEY_CLIENT_STATUS,
+                constants.CLIENT_STATUS_ONLINE,
+            )
+            logging.info(
+                "heartbeat from rank %d not currently online: treating "
+                "as (re)connect", sender,
+            )
+            self.handle_message_client_status_update(synth)
+
+    def _post_loopback(self, msg: Message, what: str, stale=None) -> bool:
+        """Post a self-addressed control message with bounded retry —
+        shared by every timer/detector thread that must reach the
+        dispatch thread (a silently lost control signal re-creates the
+        stall these features exist to prevent). ``stale()`` aborts the
+        retry when the signal is no longer needed. True = delivered
+        (or stale); False = the caller must arrange a re-fire."""
+        import time as _time
+
+        for attempt in range(3):
+            try:
+                self.send_message(msg)
+                return True
+            except Exception:  # noqa: BLE001 — transport may be flaky/tearing down
+                if stale is not None and stale():
+                    return True
+                logging.warning(
+                    "%s send failed (attempt %d/3)",
+                    what, attempt + 1, exc_info=True,
+                )
+                _time.sleep(1.0)
+        return False
+
+    def _post_client_dead(self, rank: int) -> None:
+        """FailureDetector ``on_dead`` callback (detector thread): post
+        to our own inbox so membership mutation stays on the dispatch
+        thread — the deadline-timer pattern, including its retry: the
+        declaration is one-shot (the detector unwatches before firing).
+        If the send ultimately fails, re-watch the rank so the detector
+        re-fires after another timeout instead of never."""
+        msg = Message(constants.MSG_TYPE_S2S_CLIENT_DEAD, self.rank, self.rank)
+        msg.add_params(constants.MSG_ARG_KEY_RANK, int(rank))
+        if not self._post_loopback(msg, f"death notice for rank {rank}"):
+            logging.error(
+                "failure detector: could not post death of rank %d; "
+                "re-arming the watch so it is re-declared", rank,
+            )
+            if self._failure_detector is not None:
+                self._failure_detector.watch(rank)
+
+    def handle_message_client_dead(self, msg: Message) -> None:
+        rank = int(msg.get(constants.MSG_ARG_KEY_RANK, -1))
+        if not self.client_online_status.get(rank, False):
+            return  # already offline/dead; stale declaration
+        if (
+            self._failure_detector is not None
+            and self._failure_detector.seen_recently(rank)
+        ):
+            # raced: a message from this rank was queued behind the
+            # death notice — it is alive after all
+            self._failure_detector.watch(rank)
+            return
+        self.client_online_status[rank] = False
+        self._dead_ranks.add(rank)
+        self.deaths += 1
+        self.telemetry.inc("cross_silo_clients_declared_dead_total")
+        logging.warning(
+            "rank %d declared DEAD at round %d (no traffic for %.1fs); "
+            "dropping from the current round and future broadcasts "
+            "until it reconnects",
+            rank, self.round_idx,
+            self._failure_detector.timeout_s if self._failure_detector else 0.0,
+        )
+        # same unstall path as an elastic OFFLINE leave — works with or
+        # without elastic membership (a crash is not a voluntary leave)
+        if self.is_initialized and self.aggregator.drop_expected(rank - 1):
+            if self.aggregator.check_whether_all_receive():
+                self._finish_round()
+
+    def _maybe_resync(self, rank: int) -> None:
+        """Ship the CURRENT round + params + pending assignment to a
+        rank that (re)appeared mid-round — a restarted client resumes
+        the round instead of stalling it until detector/deadline."""
+        silo_idx = self._round_assignment.get(rank)
+        if silo_idx is None:
+            return  # not part of the current round; next broadcast picks it up
+        if self.aggregator.flag_client_model_uploaded_dict.get(rank - 1, False):
+            return  # its upload already landed; nothing to redo
+        logging.info(
+            "RESYNC: rank %d rejoins round %d (silo %d)",
+            rank, self.round_idx, silo_idx,
+        )
+        self.telemetry.inc("cross_silo_resyncs_total")
+        msg = Message(constants.MSG_TYPE_S2C_RESYNC, self.rank, rank)
+        msg.add_params(
+            constants.MSG_ARG_KEY_MODEL_PARAMS,
+            self.aggregator.get_global_model_params(),
+        )
+        msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
+        msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        self.send_message(msg)
 
     def send_init_msg(self) -> None:
         """(fedml_server_manager.py:47-69)"""
@@ -252,6 +451,16 @@ class FedMLServerManager(ServerManager):
             self.send_finish()
             self.finish()
             return
+        if self._resumed:
+            # crash recovery: reconnecting clients get the CURRENT
+            # round + params as a RESYNC — same payload as an init, but
+            # the type says "mid-federation", not "round 0"
+            logging.info(
+                "resumed server releasing clients with RESYNC at round %d",
+                self.round_idx,
+            )
+            self._broadcast_model(constants.MSG_TYPE_S2C_RESYNC)
+            return
         self._broadcast_model(constants.MSG_TYPE_S2C_INIT_CONFIG)
 
     def _broadcast_model(self, msg_type: str) -> None:
@@ -267,7 +476,14 @@ class FedMLServerManager(ServerManager):
                 int(self.args.client_num_per_round), len(candidate_ids)
             )
         else:
-            candidate_ids = self.client_real_ids
+            # fixed membership still excludes detector-declared-dead
+            # ranks: broadcasting to a corpse re-stalls every round
+            # (a reconnect clears the rank from the dead set)
+            candidate_ids = [
+                rid
+                for rid in self.client_real_ids
+                if self._rank_of_real_id[rid] not in self._dead_ranks
+            ]
             n_select = len(candidate_ids)
         selected_real_ids = self.aggregator.client_selection(
             self.round_idx, candidate_ids, n_select
@@ -290,9 +506,11 @@ class FedMLServerManager(ServerManager):
         self._last_broadcast_type = msg_type
         global_params = self.aggregator.get_global_model_params()
         expected = []
+        self._round_assignment = {}
         for real_id, silo_idx in zip(selected_real_ids, silo_indexes):
             rank = self._rank_of_real_id[real_id]
             expected.append(rank - 1)
+            self._round_assignment[rank] = silo_idx
             msg = Message(msg_type, self.rank, rank)
             msg.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
@@ -313,27 +531,17 @@ class FedMLServerManager(ServerManager):
             # post to our own inbox; never mutate from the timer thread.
             # A lost deadline message re-creates the straggler hang this
             # feature exists to prevent, so transient send failures are
-            # retried and logged loudly.
-            import time as _time
-
+            # retried (shared _post_loopback policy) and logged loudly.
             msg = Message(constants.MSG_TYPE_S2S_AGG_DEADLINE, self.rank, self.rank)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
-            for attempt in range(3):
-                try:
-                    self.send_message(msg)
-                    return
-                except Exception:  # noqa: BLE001 — transport may be down
-                    if round_idx != self.round_idx:
-                        return  # round advanced/finished; stale fire
-                    logging.warning(
-                        "deadline message send failed (attempt %d/3)",
-                        attempt + 1, exc_info=True,
-                    )
-                    _time.sleep(1.0)
-            logging.error(
-                "deadline for round %d could not be delivered; the round "
-                "will only advance when all clients report", round_idx,
-            )
+            if not self._post_loopback(
+                msg, "deadline message",
+                stale=lambda: round_idx != self.round_idx,
+            ):
+                logging.error(
+                    "deadline for round %d could not be delivered; the round "
+                    "will only advance when all clients report", round_idx,
+                )
 
         self._deadline_timer = threading.Timer(self.deadline_s, fire)
         self._deadline_timer.daemon = True
@@ -474,6 +682,9 @@ class FedMLServerManager(ServerManager):
             )
         eval_round = self.round_idx
         cohort = self.aggregator.client_num  # before begin_round re-arms
+        # the completed round's broadcast set, captured BEFORE the next
+        # broadcast overwrites the assignment (WAL record)
+        cohort_ranks = sorted(self._round_assignment)
         self.round_idx += 1
         ckpt_due = (
             self._ckpt is not None
@@ -486,6 +697,7 @@ class FedMLServerManager(ServerManager):
         if self.round_idx >= self.round_num:
             if ckpt_due:
                 self._save_checkpoint()
+            self._wal_append(eval_round, ckpt_due, cohort_ranks)
             if n_aggregated:
                 self.aggregator.test_on_server_for_all_clients(eval_round)
             self._report_round(eval_round, cohort, n_aggregated)
@@ -502,6 +714,7 @@ class FedMLServerManager(ServerManager):
         self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
         if ckpt_due:
             self._save_checkpoint()
+        self._wal_append(eval_round, ckpt_due, cohort_ranks)
         if n_aggregated:
             with self.profiler.span("server_eval_overlapped"):
                 self.aggregator.test_on_server_for_all_clients(eval_round)
@@ -518,6 +731,23 @@ class FedMLServerManager(ServerManager):
                 "agg_round": self.aggregator._agg_round,
             },
         )
+
+    def _wal_append(self, eval_round: int, ckpt_saved: bool, cohort_ranks) -> None:
+        """One WAL record per COMPLETED round (crash recovery): which
+        round finished, which checkpoint step (if any) carries it, who
+        the round was broadcast to."""
+        if self._wal is None:
+            return
+        try:
+            self._wal.append(
+                eval_round,
+                self.round_idx if ckpt_saved else None,
+                cohort_ranks,
+            )
+        except OSError:
+            # the WAL is an aid to recovery, never a reason to kill a
+            # healthy federation (disk-full on the log must not)
+            logging.exception("round WAL append failed for round %d", eval_round)
 
     def _report_round(self, round_idx: int, cohort: int, n_aggregated: int) -> None:
         self.metrics_reporter.report(
@@ -542,6 +772,8 @@ class FedMLServerManager(ServerManager):
                 Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)
             )
         logging.info("server: training finished after %d rounds", self.round_idx)
+        if self._failure_detector is not None:
+            self._failure_detector.stop()
         self.telemetry.stop_watchdog()
         self.telemetry.export_run_artifacts(
             getattr(self.args, "telemetry_dir", None)

@@ -32,6 +32,7 @@ import logging
 import os
 import shutil
 import tempfile
+import time
 import urllib.request
 import zipfile
 from typing import Optional
@@ -39,6 +40,11 @@ from typing import Optional
 from ..constants import FEDML_DATA_MNIST_URL
 
 _DOWNLOAD_TIMEOUT_S = 15
+# bounded retry around each network fetch BEFORE the offline-grace
+# fallback: one transient blip (DNS hiccup, connection reset) must not
+# silently degrade a run to cached/synthetic data
+_FETCH_RETRIES = 2
+_FETCH_RETRY_BASE_S = 1.0
 
 # dataset -> archives, straight from the reference's download scripts
 # (data/<ds>/download*.sh): same hosts, same artifact names. Both
@@ -74,8 +80,52 @@ DATASET_ARCHIVES = {
 }
 
 
+def _transient_fetch_error(e: Exception) -> bool:
+    """Retry only what a second attempt can plausibly fix: timeouts,
+    resets, DNS blips, 5xx. A 4xx (gone/renamed archive) or a local
+    write error (disk full) fails the same way every time — surface it
+    to the offline-grace path immediately."""
+    import urllib.error
+
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return isinstance(
+        e, (urllib.error.URLError, TimeoutError, ConnectionError)
+    )
+
+
 def _fetch(url: str, dest: str) -> None:
-    """Stream ``url`` to ``dest`` atomically (no partial files)."""
+    """Stream ``url`` to ``dest`` atomically (no partial files), with a
+    bounded retry + backoff so one transient network error does not
+    fall straight into the offline-grace path. Only the LAST failure
+    propagates (the caller's grace handling picks the fallback)."""
+    from ..core.comm.base import backoff_delay_s
+
+    last_err: Optional[Exception] = None
+    for attempt in range(_FETCH_RETRIES + 1):
+        if attempt:
+            # rand=0: deterministic (no jitter) — a single downloader
+            # has no retry storm to decorrelate
+            delay = backoff_delay_s(
+                attempt - 1, _FETCH_RETRY_BASE_S, rand=lambda: 0.0
+            )
+            logging.warning(
+                "fetch %s failed (%s: %s); retry %d/%d in %.1fs",
+                url, type(last_err).__name__, last_err,
+                attempt, _FETCH_RETRIES, delay,
+            )
+            time.sleep(delay)
+        try:
+            _fetch_once(url, dest)
+            return
+        except Exception as e:  # noqa: BLE001 — classified below
+            last_err = e
+            if not _transient_fetch_error(e):
+                raise
+    raise last_err
+
+
+def _fetch_once(url: str, dest: str) -> None:
     tmp_name = None
     try:
         with urllib.request.urlopen(

@@ -178,6 +178,8 @@ GROUPS = [
         "aggregation_deadline_s", "aggregation_deadline_max_extensions",
         "compression", "compression_topk_ratio", "elastic_membership",
         "grpc_ipconfig_path", "grpc_port_base", "fault_injection",
+        "reliable_comm", "comm_retry_max", "comm_retry_base_s",
+        "grpc_send_timeout_s", "heartbeat_interval_s", "heartbeat_timeout_s",
     ]),
     ("Defense", ["defense_type", "norm_bound", "stddev"]),
     ("Parallelism (mesh / distributed)", [

@@ -82,6 +82,18 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"serving"' in parent or "'serving'" in parent
 
+    def test_chaos_phase_contract(self):
+        """detail.chaos ships the fault-tolerance evidence (exactly-once
+        aggregation + clean-run-identical params under faults, kill and
+        restart): the phase is in the child vocabulary and the parent
+        stitches it (like pipeline/telemetry/serving, it runs demoted
+        on the CPU fallback)."""
+        assert "chaos" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"chaos"' in parent or "'chaos'" in parent
+
 
 class TestPhaseChild:
     def _run_child(self, phase: str, timeout: int, smoke: bool = False) -> dict:
@@ -166,6 +178,33 @@ class TestPhaseChild:
         assert d["swaps"] >= 2
         assert d["one_trace_per_bucket"] is True
         assert d["shed_queue_full"] > 0
+
+    @pytest.mark.slow  # ~15s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's chaos smoke block
+    def test_chaos_smoke_child_writes_valid_json(self):
+        """The CI chaos smoke invocation (3 clients x 4 rounds, CPU):
+        the fault-tolerance layer runs end-to-end through bench.py's
+        chaos phase child — drop/dup/delay faults, one client kill
+        (replacement RESYNCed into the pending round), one server
+        crash + checkpoint/WAL restart — and emits the detail.chaos
+        contract keys with the exactly-once and params-identity
+        acceptance evidence."""
+        d = self._run_child("chaos", 420, smoke=True)
+        assert d["rounds_completed"] == d["rounds"]
+        assert d["client_killed"] is True
+        assert d["server_restarted"] is True
+        assert d["server_resumed_at_round"] == d["rounds"] - 1
+        assert d["wal_records"] == d["rounds"]
+        # the acceptance criteria as numbers: retransmits + dedups
+        # actually happened, every upload aggregated exactly once, and
+        # the final params are bit-identical to the fault-free run
+        assert d["retries_total"] > 0
+        assert d["dup_dropped_total"] > 0
+        assert d["resyncs_total"] >= 1
+        assert d["uploads_aggregated"] == d["expected_uploads"]
+        assert d["exactly_once"] is True
+        assert d["max_abs_diff_vs_clean"] == 0.0
+        assert d["params_match_clean"] is True
 
     @pytest.mark.slow  # subprocess + 2-virtual-device mesh round
     def test_mesh_cpu_child_writes_valid_json(self):

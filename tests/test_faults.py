@@ -118,6 +118,21 @@ class TestFaultInjectorUnit:
         time.sleep(0.4)
         assert [m.get_type() for m in rec.sent] == [5, 3]
 
+    def test_closed_injector_swallows_fired_delay_timer(self):
+        """Timer.cancel() only stops timers that have not FIRED yet; a
+        delay already past cancel() at teardown must not deliver into
+        a stopped transport (late sends after FINISH racing teardown).
+        stop_receive_message sets ``closed`` and fire() checks it."""
+        import time
+
+        rec = _RecordingTransport()
+        fi = FaultInjector(rec, delay_prob=1.0, delay_s=0.05)
+        fi.send_message(self._msg())
+        fi.stop_receive_message()  # before the timer fires
+        assert fi.closed
+        time.sleep(0.2)
+        assert rec.sent == []  # the fired timer no-opped
+
     def test_wrap_validation(self, args_factory):
         a = args_factory()
         assert maybe_wrap_faulty("com", a) == "com"  # no spec -> untouched
