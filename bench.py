@@ -80,7 +80,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
-    "telemetry", "serving", "chaos",
+    "telemetry", "serving", "chaos", "tracing",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -1220,6 +1220,274 @@ def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
+    """Tracing phase (docs/observability.md): a LOCAL multi-client
+    cross-silo world run twice — telemetry OFF, then distributed
+    tracing ON with ``telemetry_dir`` export — then stitched and
+    analyzed (``core/tracing.py``). Proves the acceptance contract as
+    numbers:
+
+    - every comm send span has a matched cross-process receive flow;
+    - per-round critical-path segments sum to the measured round wall
+      time within tolerance (``min_coverage``);
+    - tracing overhead vs telemetry-off stays bounded
+      (``overhead_pct``), final params are bit-identical either way,
+      and ``host_syncs_per_round`` on the pipelined cohort is unchanged
+      with tracing on (``host_syncs_match``).
+
+    ``smoke`` (CI gate): 3 clients x 4 rounds on the LR mini cohort."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.core.tracing import trace_run
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.data import load
+
+    n_clients = 3 if (smoke or on_cpu) else 4
+    rounds = 6 if (smoke or on_cpu) else 8
+    train_size = 1200 if (smoke or on_cpu) else 2400
+
+    def mk(rank, run_id, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = train_size
+        a.synthetic_test_size = 60
+        # an MLP wide enough that steady rounds run hundreds of ms:
+        # the per-message tracing cost must be measured against
+        # realistic round lengths — near-empty LR rounds (a few ms)
+        # time scheduler jitter, not instrumentation — while compiling
+        # in seconds on a 1-core CI box (a CNN would not)
+        a.model = "mlp"
+        a.hidden_dim = 512
+        a.partition_method = "hetero"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 2
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def run_world(run_id, **kw):
+        a0, ds0, m0 = mk(0, run_id, **kw)
+        server = Server(a0, None, ds0, m0)
+        # per-round end marks: the overhead figure compares STEADY
+        # rounds (1..N-1); round 0 absorbs every jit compile of its
+        # world, and each world compiles its own closures, so whole-run
+        # wall time measures compile variance, not tracing cost
+        marks = []
+        mgr = server.manager
+        orig_report = mgr._report_round
+
+        def report_and_mark(eval_round, cohort, n_aggregated):
+            orig_report(eval_round, cohort, n_aggregated)
+            marks.append(time.perf_counter())
+
+        mgr._report_round = report_and_mark
+        clients = []
+        for r in range(1, n_clients + 1):
+            a, ds, m = mk(r, run_id, **kw)
+            clients.append(Client(a, None, ds, m))
+        threads = [
+            threading.Thread(target=c.run, daemon=True, name=f"trc-c{i}")
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=120)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(f"tracing world {run_id}: threads hung: {hung}")
+        # steady per-round walls: round 0 absorbs its world's compiles
+        walls = [b - a for a, b in zip(marks, marks[1:])]
+        params = jax.tree.map(
+            np.asarray, server.aggregator.get_global_model_params()
+        )
+        return walls, params
+
+    out = {
+        "device": str(jax.devices()[0]),
+        "clients": n_clients,
+        "rounds": rounds,
+    }
+    # Overhead protocol: ALTERNATE off/on worlds — in ABBA order, so
+    # each mode runs once early and once late — and pool the steady
+    # per-round walls per mode, then compare medians. A single
+    # off-then-on pair confounds tracing cost with process drift (the
+    # later world always measures slower on a shared 1-core box), and
+    # a median resists scheduler spikes a mean would average in.
+    walls = {"off": [], "on": []}
+    params_by_mode = {}
+    tdir = _tempfile.mkdtemp(prefix="bench_tracing_")
+    try:
+        for rep in range(2):
+            for mode in ("off", "on") if rep == 0 else ("on", "off"):
+                Telemetry.reset()
+                kw = (
+                    dict(telemetry=False)
+                    if mode == "off"
+                    else dict(telemetry_dir=tdir)
+                )
+                w, params = run_world(f"bench_tracing_{mode}_{rep}", **kw)
+                walls[mode].extend(w)
+                params_by_mode[mode] = params
+                if mode == "on":
+                    tel = Telemetry.get_instance()
+                    comm_ops = sum(
+                        tel.counters_matching(
+                            "comm_messages_sent_total"
+                        ).values()
+                    ) + sum(
+                        tel.counters_matching(
+                            "comm_messages_received_total"
+                        ).values()
+                    )
+                _progress(
+                    f"tracing: {mode} rep {rep} steady rounds "
+                    f"{[round(x * 1e3) for x in w]} ms"
+                )
+        summary = trace_run(tdir)  # shards of the LAST traced world
+        with open(summary["round_report"]) as fh:
+            report = json.load(fh)
+    finally:
+        _shutil.rmtree(tdir, ignore_errors=True)
+
+    off_dt = sorted(walls["off"])[len(walls["off"]) // 2]
+    on_dt = sorted(walls["on"])[len(walls["on"]) // 2]
+    off_params, on_params = params_by_mode["off"], params_by_mode["on"]
+
+    # Deterministic attribution: the wall-clock delta above rides ±10%
+    # scheduler noise at these round lengths, so ALSO measure the
+    # instrument layer's per-message cost directly (stamping + spans +
+    # flows + counters through a sink transport, model-params payload)
+    # and attribute it against the measured comm ops per round — the
+    # stable form of the <=5% overhead claim.
+    from fedml_tpu.core.comm.base import (
+        BaseCommunicationManager as _BCM,
+    )
+    from fedml_tpu.core.comm.instrument import (
+        InstrumentedCommunicationManager as _Inst,
+    )
+    from fedml_tpu.core.message import Message as _Msg
+
+    class _Sink(_BCM):
+        def send_message(self, m):
+            pass
+
+        def add_observer(self, o):
+            pass
+
+        def remove_observer(self, o):
+            pass
+
+        def handle_receive_message(self):
+            pass
+
+        def stop_receive_message(self):
+            pass
+
+    Telemetry.reset()
+    inst = _Inst(_Sink(), Telemetry.get_instance(), rank=1)
+
+    def _bench_send(com, n=400):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            m = _Msg(3, 1, 0)
+            m.add_params(_Msg.MSG_ARG_KEY_MODEL_PARAMS, on_params)
+            m.add_params("round_idx", 1)
+            com.send_message(m)
+        return (time.perf_counter() - t0) / n
+
+    per_msg_s = max(_bench_send(inst) - _bench_send(_Sink()), 0.0)
+    ops_per_round = comm_ops / max(rounds, 1)
+    attributed_pct = per_msg_s * ops_per_round / max(off_dt, 1e-9) * 100
+
+    diff = max(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda x, y: float(np.max(np.abs(np.asarray(x) - y))),
+                on_params,
+                off_params,
+            )
+        )
+    )
+    coverages = [
+        r["coverage"] for r in report["rounds"] if r["coverage"] is not None
+    ]
+    flows = summary["flows"]
+    out.update(
+        {
+            "off_rounds_per_sec": round(1.0 / off_dt, 4),
+            "on_rounds_per_sec": round(1.0 / on_dt, 4),
+            "overhead_pct": round((on_dt - off_dt) / max(off_dt, 1e-9) * 100, 2),
+            "instrument_us_per_msg": round(per_msg_s * 1e6, 1),
+            "comm_ops_per_round": round(ops_per_round, 1),
+            "attributed_overhead_pct": round(attributed_pct, 2),
+            "overhead_within_5pct": attributed_pct <= 5.0,
+            "params_match_off": diff == 0.0,
+            "trace_events": summary["events"],
+            "flow_starts": flows["flow_starts"],
+            "flows_matched": flows["matched"],
+            "all_flows_matched": flows["unmatched_starts"] == 0,
+            "rounds_analyzed": summary["rounds_analyzed"],
+            # named segments / round wall, worst round: 1.0 would mean
+            # the critical path explains every microsecond
+            "min_coverage": round(min(coverages), 4) if coverages else None,
+            "segments_sum_within_5pct": bool(coverages)
+            and min(coverages) >= 0.95,
+            "straggler_ranks": [
+                r["straggler_rank"] for r in report["rounds"]
+            ],
+        }
+    )
+    _progress(
+        f"tracing: {flows['matched']}/{flows['flow_starts']} flows matched, "
+        f"min coverage {out['min_coverage']}, overhead {out['overhead_pct']}%"
+    )
+
+    # -- host-sync identity on the pipelined cohort -------------------
+    # (the simulation hot loop must not gain a device fetch from
+    # tracing; same contract the telemetry phase pins, re-proven here
+    # with the tracing-era instrument layer)
+    n_rounds, cohort = _pipeline_cohort(on_cpu=True, smoke=True)
+    args, api = _build_pipeline_api(n_rounds, cohort, pipeline_depth=4)
+    syncs = {}
+    for mode in ("off", "on"):
+        Telemetry.reset()
+        api.telemetry = Telemetry.get_instance(args)
+        api.telemetry.enabled = mode == "on"
+        api.telemetry.attach_profiler(api.profiler)
+        api.train()
+        syncs[mode] = api.pipeline_stats.get("host_syncs_per_round")
+    out["host_syncs_per_round"] = syncs["on"]
+    out["host_syncs_match"] = syncs["on"] == syncs["off"]
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -1321,6 +1589,9 @@ _SERVING_TIMEOUT_S = 180.0
 # two LOCAL worlds (clean + chaos) with a kill and a server restart;
 # dominated by jit compiles on a cold 1-core box
 _CHAOS_TIMEOUT_S = 300.0
+# two LOCAL worlds (telemetry off vs tracing on) + stitch/analyze +
+# a mini pipelined off/on pair for the host-sync identity figure
+_TRACING_TIMEOUT_S = 300.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1591,6 +1862,11 @@ def _main_guarded() -> None:
     # complete with exactly-once aggregation and clean-run-identical
     # params — robustness as a measured contract
     _run_demoted_phase("chaos", _CHAOS_TIMEOUT_S)
+    # tracing phase (distributed tracing + critical path): matched
+    # cross-process flows, segment sums vs round wall, tracing overhead
+    # vs telemetry-off, host-syncs identity — observability as a
+    # measured contract
+    _run_demoted_phase("tracing", _TRACING_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -1732,6 +2008,8 @@ def _phase_main(argv) -> None:
         out = run_serving(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "chaos":
         out = run_chaos(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "tracing":
+        out = run_tracing(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -43,3 +43,14 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_chaos_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Tracing smoke (3 clients x 6 rounds, ABBA off/on worlds, CPU): the
+# distributed-tracing layer must run end-to-end through bench.py's
+# tracing phase child and emit the detail.tracing contract keys —
+# every comm send span flow-matched to its receive, per-round
+# critical-path segments summing to round wall time, attributed
+# tracing overhead within bound, aggregation bit-identical and
+# host-syncs-per-round unchanged with tracing on.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_tracing_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -173,6 +173,27 @@ _DEFAULTS: Dict[str, Any] = {
     # dump a debug bundle (open spans, pending deferred metrics, last-N
     # trace events, host+device sys_stats) to telemetry_dir. 0 disables
     "stall_timeout_s": 0.0,
+    # flight-recorder ring capacity (events). Overflow evicts oldest,
+    # counted in telemetry_trace_dropped_total and the exported trace's
+    # meta — a run that outgrows the ring is visible, not silent
+    "trace_ring_size": 65536,
+    # on-demand device profiling (core/tracing.py RoundProfiler): round
+    # indices (list or "1,5,9" string) to capture a programmatic
+    # jax.profiler trace for, into telemetry_dir/profile/round_NNNN.
+    # No-op with one logged warning on backends without capture support
+    "profile_rounds": None,
+    # pull-based exposition: serve Telemetry.prometheus_text() at
+    # http://<metrics_host>:<port>/metrics for the run's lifetime.
+    # 0 (default) = off
+    "metrics_port": 0,
+    # bind address for the /metrics server. Loopback by default: the
+    # endpoint is unauthenticated, so exposing it on the network is an
+    # explicit choice ("0.0.0.0"), never the default
+    "metrics_host": "127.0.0.1",
+    # per-round latency SLO (cross-silo server): a round whose wall
+    # time (broadcast -> aggregate done) exceeds this many seconds
+    # counts into slo_violations_total. 0 disables
+    "round_deadline_s": 0.0,
     # serving plane (fedml_tpu/serving — `fedml_tpu.cli serve`):
     # bounded request queue; a full queue sheds new requests
     # (serving_shed_total{reason=queue_full}) instead of growing
@@ -391,6 +412,29 @@ class Arguments:
             raise ValueError(
                 f"stall_timeout_s={self.stall_timeout_s}: must be >= 0 "
                 "(0 disables the stall watchdog)"
+            )
+        for int_key in ("trace_ring_size", "metrics_port"):
+            setattr(self, int_key, int(getattr(self, int_key)))
+        if self.trace_ring_size < 1:
+            raise ValueError(
+                f"trace_ring_size={self.trace_ring_size}: must be >= 1"
+            )
+        if not 0 <= self.metrics_port <= 65535:
+            raise ValueError(
+                f"metrics_port={self.metrics_port}: must be a port number "
+                "(0 disables the /metrics server)"
+            )
+        self.round_deadline_s = float(self.round_deadline_s)
+        if self.round_deadline_s < 0:
+            raise ValueError(
+                f"round_deadline_s={self.round_deadline_s}: must be >= 0 "
+                "(0 disables the round SLO)"
+            )
+        pr = getattr(self, "profile_rounds", None)
+        if pr is not None and not isinstance(pr, (str, list, tuple)):
+            raise ValueError(
+                f"profile_rounds={pr!r}: pass a list of round indices or "
+                "a comma-separated string"
             )
 
     # -- niceties ------------------------------------------------------

@@ -17,6 +17,12 @@ dependency):
   external MLOps serving tier): stand up the TPU-native serving plane
   (``fedml_tpu/serving``) for the federated global model, hot-swapping
   weights from a checkpoint dir as the trainer publishes new rounds.
+- ``trace``    — beyond the reference: stitch the per-process trace
+  shards a run exported into ``telemetry_dir`` into ONE
+  perfetto-loadable timeline (cross-process flow events matched,
+  per-rank clock skew corrected) and run the round critical-path
+  analyzer — ``trace_merged.json`` + ``round_report.json``
+  (``core/tracing.py``, docs/observability.md).
 
 State lives under ``~/.fedml_tpu/`` (override: FEDML_TPU_HOME).
 """
@@ -230,6 +236,36 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Stitch a run's trace shards + analyze round critical paths.
+
+    Prints one JSON summary line (shards, matched flows, rounds
+    analyzed, artifact paths); per-round detail goes to
+    ``round_report.json``. ``--summary`` additionally pretty-prints the
+    per-round segment table to stderr for quick terminal reading."""
+    from .core.tracing import trace_run
+
+    try:
+        out = trace_run(args.telemetry_dir, out_dir=args.out)
+    except FileNotFoundError as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 2
+    if args.summary:
+        with open(out["round_report"]) as fh:
+            report = json.load(fh)
+        for r in report["rounds"]:
+            segs = ", ".join(
+                f"{k}={v * 1e3:.1f}ms" for k, v in r["segments_s"].items()
+            )
+            print(
+                f"round {r['round']}: wall={r['wall_s'] * 1e3:.1f}ms "
+                f"straggler=rank{r['straggler_rank']} [{segs}]",
+                file=sys.stderr,
+            )
+    print(json.dumps(out))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fedml-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -258,6 +294,22 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--run-id", dest="run_id", default="0")
     serve.add_argument("--dry-run", action="store_true")
     serve.set_defaults(fn=cmd_serve)
+
+    trace = sub.add_parser("trace")
+    trace.add_argument(
+        "--telemetry-dir", required=True,
+        help="directory holding the run's trace*.json shards",
+    )
+    trace.add_argument(
+        "--out", default=None,
+        help="where to write trace_merged.json / round_report.json "
+             "(default: the telemetry dir itself)",
+    )
+    trace.add_argument(
+        "--summary", action="store_true",
+        help="also print a per-round segment table to stderr",
+    )
+    trace.set_defaults(fn=cmd_trace)
 
     build = sub.add_parser("build")
     build.add_argument("-t", "--type", required=True, choices=["client", "server"])

@@ -116,3 +116,18 @@ MSG_ARG_KEY_COMM_ACK_SEQ = "comm_ack_seq"
 MSG_ARG_KEY_COMM_ACK_CHAN = "comm_ack_chan"
 # failure-detector internals: which rank was declared dead
 MSG_ARG_KEY_RANK = "rank"
+
+# Distributed-tracing context (core/tracing.py — beyond the reference,
+# which has no cross-process causality at all): every tracked message
+# carries W3C-style trace context so a broadcast → local-train → upload
+# → aggregate chain is one causally-linked trace across processes and
+# backends. ``TRACE_ID`` names the run-wide trace, ``TRACE_SPAN`` the
+# sending span (the receiver's parent), ``TRACE_FLOW`` a per-wire-send
+# unique id that pairs the Chrome-trace flow events (ph "s"/"f") the
+# stitcher matches across shards. ``TRAIN_SECONDS`` rides on uploads so
+# the server can attribute round time to client compute live (the
+# stitched analyzer computes the precise version offline).
+MSG_ARG_KEY_TRACE_ID = "trace_id"
+MSG_ARG_KEY_TRACE_SPAN = "trace_span"
+MSG_ARG_KEY_TRACE_FLOW = "trace_flow"
+MSG_ARG_KEY_TRAIN_SECONDS = "train_seconds"

@@ -3,9 +3,20 @@
 Same decorator pattern as ``faults.maybe_wrap_faulty``: wrap any
 ``BaseCommunicationManager`` (local / grpc / mqtt / tensor_rpc) and
 count messages, payload bytes and send latency per message type into
-the process-wide ``Telemetry`` registry (``core/telemetry.py``), plus a
-flight-recorder instant per send so comm activity lands on the same
-perfetto timeline as compute spans.
+the process-wide ``Telemetry`` registry (``core/telemetry.py``), plus
+flight-recorder spans so comm activity lands on the same perfetto
+timeline as compute spans.
+
+Distributed tracing (``core/tracing.py``): every outbound message is
+stamped with trace context (``trace_id`` + a per-send unique flow id)
+and every wire send/receive becomes a ``comm.send``/``comm.recv`` span
+carrying a Chrome-trace flow event (``ph:"s"`` inside the send span,
+``ph:"f"`` inside the receive span) — the cross-process edges the
+trace stitcher matches across shards. A message re-entering this layer
+with context already stamped (a ``ReliableChannel`` retransmit or an
+injected duplicate) keeps its original flow id, so whichever copy
+arrives first completes the SAME flow, and its send span is tagged
+``retry``.
 
 Counting semantics (see tests/test_telemetry.py):
 
@@ -21,7 +32,10 @@ Counting semantics (see tests/test_telemetry.py):
 Payload bytes are estimated from array/bytes leaf sizes (``nbytes`` is
 metadata — reading it never serializes the payload or touches the
 device), so instrumentation adds no host syncs and no double
-serialization on the zero-copy LOCAL fabric.
+serialization on the zero-copy LOCAL fabric. Trace-context params are
+excluded from the estimate — they are comm metadata, and their
+inclusion would make a retransmit's byte count differ from its
+original's.
 """
 
 from __future__ import annotations
@@ -31,14 +45,19 @@ from typing import Any, Dict
 
 from .base import BaseCommunicationManager, Observer
 from ..message import Message
+from ..tracing import TRACE_CTX_KEYS, stamp_context
+from ... import constants
 
 
 def payload_nbytes(msg: Message) -> int:
     """Approximate wire size of a message from leaf metadata only."""
     import jax
 
+    params = {
+        k: v for k, v in msg.get_params().items() if k not in TRACE_CTX_KEYS
+    }
     total = 0
-    for leaf in jax.tree_util.tree_leaves(msg.get_params()):
+    for leaf in jax.tree_util.tree_leaves(params):
         nb = getattr(leaf, "nbytes", None)
         if nb is not None:
             total += int(nb)
@@ -55,9 +74,33 @@ class _CountingObserver(Observer):
         self.telemetry = telemetry
 
     def receive_message(self, msg_type: int, msg_params: Message) -> None:
-        self.telemetry.inc("comm_messages_received_total", msg_type=int(msg_type))
-        self.telemetry.heartbeat("comm.receive", int(msg_type))
-        self.inner.receive_message(msg_type, msg_params)
+        t = int(msg_type)
+        tel = self.telemetry
+        tel.inc("comm_messages_received_total", msg_type=t)
+        tel.heartbeat("comm.receive", t)
+        get = getattr(msg_params, "get", None)
+        flow = get(constants.MSG_ARG_KEY_TRACE_FLOW) if get else None
+        span_args: Dict[str, Any] = {"msg_type": t}
+        if get:
+            sender = msg_params.get_sender_id()
+            span_args["sender"] = int(sender)
+            rnd = get(constants.MSG_ARG_KEY_ROUND_INDEX)
+            if rnd is not None:
+                span_args["round"] = int(rnd)
+        if flow is not None:
+            span_args["flow"] = int(flow)
+        rec = tel.recorder
+        # the receive span wraps handler dispatch, so on the LOCAL
+        # fabric it encloses the work the message triggered; the flow
+        # finish sits inside it (chrome binds "f"/bp:"e" to the
+        # enclosing slice)
+        rec.begin("comm.recv", cat="comm", **span_args)
+        if flow is not None:
+            rec.flow_end(int(flow), name="comm.msg", cat="comm", msg_type=t)
+        try:
+            self.inner.receive_message(msg_type, msg_params)
+        finally:
+            rec.end("comm.recv", cat="comm")
 
 
 class InstrumentedCommunicationManager(BaseCommunicationManager):
@@ -66,26 +109,55 @@ class InstrumentedCommunicationManager(BaseCommunicationManager):
     injector's timer thread is counted when it actually goes out —
     the registry is thread-safe)."""
 
-    def __init__(self, inner: BaseCommunicationManager, telemetry) -> None:
+    def __init__(
+        self, inner: BaseCommunicationManager, telemetry, rank: int = 0
+    ) -> None:
         self.inner = inner
         self.telemetry = telemetry
+        self.rank = int(rank)
         self._observer_wrappers: Dict[Any, _CountingObserver] = {}
 
     def send_message(self, msg: Message) -> None:
         t = int(msg.get_type())
+        # nbytes BEFORE stamping: the estimate must be identical for an
+        # original and its retransmit (and match a caller's pre-send
+        # estimate)
         nbytes = payload_nbytes(msg)
+        flow_id, is_resend = stamp_context(msg, self.telemetry, self.rank)
+        span_args: Dict[str, Any] = {
+            "msg_type": t,
+            "nbytes": nbytes,
+            "sender": int(msg.get_sender_id()),
+            "receiver": int(msg.get_receiver_id()),
+        }
+        rnd = msg.get(constants.MSG_ARG_KEY_ROUND_INDEX)
+        if rnd is not None:
+            span_args["round"] = int(rnd)
+        if flow_id is not None:
+            span_args["flow"] = int(flow_id)
+        parent = msg.get(constants.MSG_ARG_KEY_TRACE_SPAN)
+        if parent is not None:
+            # causal parent (continue_context): the flow id of the
+            # message that triggered this send — renders the
+            # broadcast->upload ancestry in the merged trace
+            span_args["parent"] = int(parent)
+        if is_resend:
+            span_args["retry"] = True
+        rec = self.telemetry.recorder
+        rec.begin("comm.send", cat="comm", **span_args)
+        if flow_id is not None:
+            rec.flow_start(int(flow_id), name="comm.msg", cat="comm", msg_type=t)
         t0 = time.perf_counter()
-        self.inner.send_message(msg)
+        try:
+            self.inner.send_message(msg)
+        finally:
+            rec.end("comm.send", cat="comm")
         dt = time.perf_counter() - t0
         tel = self.telemetry
         tel.inc("comm_messages_sent_total", msg_type=t)
         tel.inc("comm_bytes_sent_total", nbytes, msg_type=t)
         tel.observe("comm_send_latency_s", dt, msg_type=t)
         tel.heartbeat("comm.send", t)
-        tel.recorder.instant(
-            "comm.send", cat="comm", msg_type=t, nbytes=nbytes,
-            sender=int(msg.get_sender_id()), receiver=int(msg.get_receiver_id()),
-        )
 
     # -- observers (receive-side counting) ----------------------------
     def add_observer(self, observer: Observer) -> None:
@@ -140,8 +212,8 @@ def wrap_instrumented(com: BaseCommunicationManager, args) -> BaseCommunicationM
     tel = Telemetry.get_instance(args)
     if not tel.enabled or not bool(getattr(args, "telemetry", True)):
         return com
-    inst = InstrumentedCommunicationManager(com, tel)
     rank = int(getattr(args, "rank", 0) or 0)
+    inst = InstrumentedCommunicationManager(com, tel, rank=rank)
     # weakref: the probe lives in the process-wide registry and must
     # not pin a torn-down comm stack (fabric queues, observers) alive
     ref = weakref.ref(inst)

@@ -246,6 +246,18 @@ class ReliableChannel(BaseCommunicationManager):
             entry.retries, msg.get_type(),
             msg.get_sender_id(), msg.get_receiver_id(), seq,
         )
+        # retransmits are first-class trace spans: the re-send
+        # re-traverses the instrumented layer (which keeps the original
+        # flow id and tags its comm.send span `retry`), and this outer
+        # comm.retry span makes the retransmit attempt itself visible
+        # on the stitched timeline with its attempt number
+        from ..telemetry import Telemetry
+
+        rec = Telemetry.get_instance().recorder
+        rec.begin(
+            "comm.retry", cat="comm",
+            msg_type=int(msg.get_type()), seq=int(seq), attempt=entry.retries,
+        )
         try:
             self.inner.send_message(msg)
         except Exception:
@@ -253,6 +265,8 @@ class ReliableChannel(BaseCommunicationManager):
                 "reliable: retransmit of seq %d failed; backing off",
                 seq, exc_info=True,
             )
+        finally:
+            rec.end("comm.retry", cat="comm")
         self._schedule(seq)
 
     # -- receive side (driven by _ReliableObserver) --------------------

@@ -203,7 +203,14 @@ class RoundPipeline:
                 final_stats = stats
                 api.metrics_reporter.report_server_training_metric(stats)
 
+        # on-demand device profiling (core/tracing.py): with K rounds in
+        # flight the capture window is dispatch-to-dispatch of the listed
+        # round, which brackets its device work under back-pressure
+        profiler = getattr(api, "_round_profiler", None)
+
         for i, round_idx in enumerate(range(start_round, comm_rounds)):
+            if profiler is not None:
+                profiler.tick(round_idx)
             t0 = time.perf_counter()
             if prev_round is not None and prev_round in t_dispatch:
                 durations[prev_round] = t0 - t_dispatch[prev_round]

@@ -53,12 +53,14 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["Telemetry", "FlightRecorder", "StallWatchdog"]
+__all__ = ["Telemetry", "FlightRecorder", "StallWatchdog", "MetricsServer"]
 
 # Chrome trace event phases this recorder emits: duration begin/end,
-# instant, counter (https://docs.google.com/document/d/1CvAClvFfyA5R-
-# PhYUmn5OOQtYMH4h6I0nSsKchNAySU — the perfetto-supported legacy JSON).
-_TRACE_PHASES = ("B", "E", "i", "C")
+# instant, counter, flow start/finish (https://docs.google.com/document/
+# d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU — the perfetto-
+# supported legacy JSON). "s"/"f" are the cross-process send→receive
+# edges the trace stitcher (core/tracing.py) matches across shards.
+_TRACE_PHASES = ("B", "E", "i", "C", "s", "f")
 
 
 def _sanitize_metric(name: str) -> str:
@@ -103,8 +105,27 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=self.capacity)
+        # one instant: the wall clock at ring-relative ts 0. Shards from
+        # different processes are first aligned on this anchor by the
+        # trace stitcher (core/tracing.py), then skew-corrected from
+        # matched flow pairs — perf_counter epochs are per-process.
         self._t0 = time.perf_counter()
+        self.wall_t0 = time.time() - (time.perf_counter() - self._t0)
         self.dropped = 0
+
+    def resize(self, capacity: int) -> None:
+        """Re-bound the ring (``trace_ring_size`` adopted after the
+        argless singleton was created first); keeps buffered events up
+        to the new bound. Events evicted by a SHRINK are counted as
+        dropped — the ring's contract is that missing events are
+        visible, however they went missing."""
+        capacity = int(capacity)
+        if capacity == self.capacity or capacity < 1:
+            return
+        with self._lock:
+            self.dropped += max(len(self._events) - capacity, 0)
+            self.capacity = capacity
+            self._events = deque(self._events, maxlen=capacity)
 
     def __len__(self) -> int:
         return len(self._events)
@@ -112,7 +133,14 @@ class FlightRecorder:
     def _ts_us(self) -> float:
         return round((time.perf_counter() - self._t0) * 1e6, 1)
 
-    def _emit(self, ph: str, name: str, cat: str, args: Optional[dict]) -> None:
+    def _emit(
+        self,
+        ph: str,
+        name: str,
+        cat: str,
+        args: Optional[dict],
+        extra: Optional[dict] = None,
+    ) -> None:
         if not self.enabled:
             return
         ev: Dict[str, Any] = {
@@ -125,6 +153,8 @@ class FlightRecorder:
         }
         if ph == "i":
             ev["s"] = "t"  # thread-scoped instant
+        if extra:
+            ev.update(extra)
         if args:
             ev["args"] = args
         with self._lock:
@@ -140,6 +170,23 @@ class FlightRecorder:
 
     def instant(self, name: str, cat: str = "event", **args: Any) -> None:
         self._emit("i", name, cat, args or None)
+
+    def flow_start(
+        self, flow_id: int, name: str = "msg", cat: str = "flow", **args: Any
+    ) -> None:
+        """Flow-start ("s") edge of a cross-thread/process arrow. Emit
+        it INSIDE an open B/E span — chrome/perfetto bind a flow to the
+        slice enclosing its timestamp on that track."""
+        self._emit("s", name, cat, args or None, extra={"id": int(flow_id)})
+
+    def flow_end(
+        self, flow_id: int, name: str = "msg", cat: str = "flow", **args: Any
+    ) -> None:
+        """Flow-finish ("f", binding-point "e": enclosing slice)."""
+        self._emit(
+            "f", name, cat, args or None,
+            extra={"id": int(flow_id), "bp": "e"},
+        )
 
     def counter(self, name: str, value: float, cat: str = "counter") -> None:
         self._emit("C", name, cat, {name: value})
@@ -177,7 +224,14 @@ class FlightRecorder:
         payload = {
             "traceEvents": out,
             "displayTimeUnit": "ms",
-            "otherData": {"events_dropped": dropped, **(meta or {})},
+            "otherData": {
+                "events_dropped": dropped,
+                "ring_capacity": self.capacity,
+                # the stitcher's cross-shard alignment anchor: wall
+                # clock (µs) at this shard's ts 0
+                "wall_t0_us": round(self.wall_t0 * 1e6, 1),
+                **(meta or {}),
+            },
         }
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         tmp = path + ".tmp"
@@ -216,8 +270,17 @@ class Telemetry:
         self._profilers: List[Any] = []
         self._deferred: List[Any] = []
         self._watchdog: Optional["StallWatchdog"] = None
+        self._metrics_server: Optional["MetricsServer"] = None
+        # serializes export_run_artifacts: in a single-process LOCAL
+        # world every manager's finish() exports through this one
+        # registry, and two concurrent exports would race on the same
+        # trace.json.tmp (the loser's os.replace finds it gone)
+        self._export_lock = threading.Lock()
         self._reporter = None  # lazy MetricsReporter (sink seam)
-        self.recorder = FlightRecorder()
+        self.recorder = FlightRecorder(
+            capacity=int(getattr(args, "trace_ring_size", 65536) or 65536)
+            if args else 65536
+        )
         self.recorder.enabled = self._enabled
 
     # -- singleton -----------------------------------------------------
@@ -234,8 +297,10 @@ class Telemetry:
     @classmethod
     def reset(cls) -> None:
         """Drop the singleton (tests; autouse fixture in conftest)."""
-        if cls._instance is not None and cls._instance._watchdog is not None:
-            cls._instance._watchdog.stop()
+        if cls._instance is not None:
+            if cls._instance._watchdog is not None:
+                cls._instance._watchdog.stop()
+            cls._instance.stop_metrics_server()
         cls._instance = None
 
     def rebind(self, args) -> None:
@@ -249,6 +314,9 @@ class Telemetry:
             "server" if self.rank == 0 else "client"
         )
         self.enabled = bool(getattr(args, "telemetry", self._enabled))
+        ring = getattr(args, "trace_ring_size", None)
+        if ring:
+            self.recorder.resize(int(ring))
 
     # -- enable switch -------------------------------------------------
     @property
@@ -411,7 +479,22 @@ class Telemetry:
             return name
         return name + "{" + ",".join(f"{k}={v}" for k, v in tags) + "}"
 
+    def _sync_trace_drops(self) -> None:
+        """Mirror the flight-recorder's ring-overflow count into
+        ``telemetry_trace_dropped_total`` so a silently-wrapped ring is
+        visible in every exposition (``dropped`` is monotonic, so the
+        absolute assignment keeps counter semantics)."""
+        if not self._enabled:
+            return
+        dropped = self.recorder.dropped
+        if dropped:
+            with self._lock:
+                self._counters[
+                    self._key("telemetry_trace_dropped_total", {})
+                ] = float(dropped)
+
     def snapshot(self) -> Dict[str, Any]:
+        self._sync_trace_drops()
         with self._lock:
             counters = {self._fmt(n, t): v for (n, t), v in self._counters.items()}
             gauges = {self._fmt(n, t): v for (n, t), v in self._gauges.items()}
@@ -448,6 +531,7 @@ class Telemetry:
 
     def prometheus_text(self) -> str:
         """Standard Prometheus text exposition of the registry."""
+        self._sync_trace_drops()
         base = {"run_id": self.run_id, "rank": self.rank, "role": self.role}
 
         def labels(tags: Tuple, **extra: Any) -> str:
@@ -518,6 +602,29 @@ class Telemetry:
             self._watchdog.stop()
             self._watchdog = None
 
+    def maybe_start_metrics_server(self, args) -> Optional["MetricsServer"]:
+        """Start (or return the running) pull-based ``/metrics``
+        endpoint when ``args.metrics_port`` > 0 and telemetry is
+        enabled. Off by default — scrape-style exposition is opt-in."""
+        port = int(getattr(args, "metrics_port", 0) or 0)
+        if not self._enabled or port <= 0:
+            return None
+        if self._metrics_server is not None and self._metrics_server.alive():
+            return self._metrics_server
+        host = str(getattr(args, "metrics_host", None) or "127.0.0.1")
+        try:
+            self._metrics_server = MetricsServer(self, port, host=host).start()
+        except OSError as e:
+            # a busy port must not kill the run the metrics describe
+            logging.error("metrics server on port %d failed: %s", port, e)
+            self._metrics_server = None
+        return self._metrics_server
+
+    def stop_metrics_server(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
     def set_system_gauges(self, sample: Dict[str, Any]) -> None:
         """Mirror a ``sys_stats`` sample's numeric fields into
         ``sys_*`` gauges — the ONE naming/filter rule shared by the
@@ -552,18 +659,23 @@ class Telemetry:
         if not self._enabled or not out_dir:
             return None
         try:
-            self.sample_system_gauges()
-            os.makedirs(out_dir, exist_ok=True)
-            suffix = "" if self.rank == 0 else f"_rank{self.rank}"
-            meta = {"run_id": self.run_id, "rank": self.rank, "role": self.role}
-            self.recorder.export(
-                os.path.join(out_dir, f"trace{suffix}.json"), meta=meta
-            )
-            with open(os.path.join(out_dir, f"metrics{suffix}.prom"), "w") as fh:
-                fh.write(self.prometheus_text())
-            snap = self.snapshot()  # records carry their rank already
-            with open(os.path.join(out_dir, "telemetry.jsonl"), "a") as fh:
-                fh.write(json.dumps({"ts": time.time(), **snap}) + "\n")
+            with self._export_lock:
+                self.sample_system_gauges()
+                os.makedirs(out_dir, exist_ok=True)
+                suffix = "" if self.rank == 0 else f"_rank{self.rank}"
+                meta = {
+                    "run_id": self.run_id, "rank": self.rank, "role": self.role,
+                }
+                self.recorder.export(
+                    os.path.join(out_dir, f"trace{suffix}.json"), meta=meta
+                )
+                with open(
+                    os.path.join(out_dir, f"metrics{suffix}.prom"), "w"
+                ) as fh:
+                    fh.write(self.prometheus_text())
+                snap = self.snapshot()  # records carry their rank already
+                with open(os.path.join(out_dir, "telemetry.jsonl"), "a") as fh:
+                    fh.write(json.dumps({"ts": time.time(), **snap}) + "\n")
         except Exception:  # noqa: BLE001 — never kill the run
             logging.exception("telemetry export to %s failed", out_dir)
             return None
@@ -698,3 +810,72 @@ class StallWatchdog:
         self.bundles.append(path)
         logging.error("stall debug bundle written to %s", path)
         return path
+
+
+class MetricsServer:
+    """Tiny stdlib HTTP exposition endpoint: ``GET /metrics`` returns
+    ``Telemetry.prometheus_text()`` (scrape-style pull, the push-less
+    complement to the JSONL sinks). Serves on ``args.metrics_port``
+    (off by default), started and stopped with the run; the listener
+    thread is a daemon so a leaked server can never hold a process
+    open. Binds loopback by default — an unauthenticated endpoint
+    inside the training process must be opted onto the network
+    (``metrics_host: 0.0.0.0``), never exposed by default."""
+
+    def __init__(
+        self, telemetry: Telemetry, port: int, host: str = "127.0.0.1"
+    ) -> None:
+        import http.server
+
+        self.telemetry = telemetry
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — stdlib API name
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer.telemetry.prometheus_text().encode()
+                except Exception as e:  # noqa: BLE001 — a scrape must not crash
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:  # noqa: A003
+                logging.debug("metrics server: " + fmt, *args)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (str(host), int(port)), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_port)  # resolved (0 = ephemeral)
+        self._thread: Optional[threading.Thread] = None
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.2},
+                daemon=True,
+                name="telemetry-metrics-server",
+            )
+            self._thread.start()
+            logging.info("metrics server serving /metrics on port %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

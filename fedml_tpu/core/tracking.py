@@ -69,18 +69,22 @@ class ProfilerEvent:
         """Drop the singleton so state cannot leak across tests."""
         cls._instance = None
 
-    def log_event_started(self, event_name: str, value: Any = None) -> None:
+    def log_event_started(
+        self, event_name: str, value: Any = None, **trace_args: Any
+    ) -> None:
         self._open[event_name] = time.perf_counter()
         if self.recorder is not None:
-            self.recorder.begin(event_name, cat="profiler")
+            self.recorder.begin(event_name, cat="profiler", **trace_args)
 
-    def log_event_ended(self, event_name: str, value: Any = None) -> None:
+    def log_event_ended(
+        self, event_name: str, value: Any = None, **trace_args: Any
+    ) -> None:
         t0 = self._open.pop(event_name, None)
         if t0 is None:
             logging.warning("span %r ended without start", event_name)
             return
         if self.recorder is not None:
-            self.recorder.end(event_name, cat="profiler")
+            self.recorder.end(event_name, cat="profiler", **trace_args)
         dt = time.perf_counter() - t0
         self.spans.append(
             {"name": event_name, "duration_s": dt, "ended_at": time.time()}
@@ -88,9 +92,12 @@ class ProfilerEvent:
         self.totals[event_name] += dt
         self.counts[event_name] += 1
 
-    def span(self, name: str):
-        """Context-manager sugar the reference lacks."""
-        return _Span(self, name)
+    def span(self, name: str, **trace_args: Any):
+        """Context-manager sugar the reference lacks. ``trace_args``
+        land on the mirrored flight-recorder span (round / rank tags
+        the critical-path analyzer reads); the span record itself is
+        unchanged."""
+        return _Span(self, name, **trace_args)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         return {
@@ -100,12 +107,13 @@ class ProfilerEvent:
 
 
 class _Span:
-    def __init__(self, ev: ProfilerEvent, name: str) -> None:
+    def __init__(self, ev: ProfilerEvent, name: str, **trace_args: Any) -> None:
         self.ev, self.name = ev, name
+        self.trace_args = trace_args
         self._annotation = None
 
     def __enter__(self):
-        self.ev.log_event_started(self.name)
+        self.ev.log_event_started(self.name, **self.trace_args)
         # named region in any active XLA device trace (no-op otherwise)
         import jax.profiler
 

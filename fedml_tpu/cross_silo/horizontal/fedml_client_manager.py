@@ -208,16 +208,34 @@ class FedMLClientManager(ClientManager):
         super().finish()
 
     def _train_and_send(self, msg: Message) -> None:
+        import time as _time
+
         params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))
         self.trainer.update_dataset(client_index)
-        with self.profiler.span("train"):
+        t_train = _time.perf_counter()
+        # round/rank tags land on the flight-recorder span — the
+        # critical-path analyzer (core/tracing.py) attributes the
+        # straggler's compute segment from them
+        with self.profiler.span("train", round=round_idx, rank=self.rank):
             new_params, n = self.trainer.train(params, round_idx)
+        train_s = _time.perf_counter() - t_train
         self.telemetry.heartbeat(f"client{self.rank}.train", round_idx)
         out = Message(
             constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, self.server_rank
         )
+        # causal link: the upload names the broadcast that caused it
+        # (trace id + parent flow), so the stitched trace carries one
+        # broadcast -> train -> upload -> aggregate chain per client
+        from ...core.tracing import continue_context
+
+        continue_context(msg, out)
+        # server-side live attribution: how long local training ran
+        # (the precise cross-process version comes from the stitched
+        # trace; this rides the upload so the server can emit
+        # round_segment_seconds without waiting for a trace merge)
+        out.add_params(constants.MSG_ARG_KEY_TRAIN_SECONDS, float(train_s))
         if self._encoder is not None:
             # compressed uplink (core/compression.py): ship the encoded
             # update delta; the server reconstructs against the same

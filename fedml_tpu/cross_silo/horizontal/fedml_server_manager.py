@@ -120,6 +120,26 @@ class FedMLServerManager(ServerManager):
         # watchdog (self.telemetry comes from _ManagerBase)
         self.telemetry.attach_profiler(self.profiler)
         self.telemetry.maybe_start_watchdog(args)
+        # pull-based exposition (core/telemetry.py MetricsServer): live
+        # /metrics scrape endpoint for the run, off unless metrics_port
+        self.telemetry.maybe_start_metrics_server(args)
+        # on-demand per-round device profiling (core/tracing.py)
+        from ...core.tracing import RoundProfiler
+
+        self._round_profiler = RoundProfiler(args)
+        # live critical-path attribution (docs/observability.md): per
+        # round the server observes broadcast/wait/aggregate segments,
+        # straggler slack (who held the round and by how much), and SLO
+        # violations against round_deadline_s — the offline analyzer
+        # (cli trace) computes the precise cross-process version
+        self.round_deadline_s = float(
+            getattr(args, "round_deadline_s", 0) or 0
+        )
+        self._bcast_t0 = None  # perf_counter at round broadcast start
+        self._bcast_done_t = None
+        self._upload_arrivals: Dict[int, float] = {}
+        self._upload_train_s: Dict[int, float] = {}
+        self._round_span_open = False
         self._wait_open = False
         self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
         self._deadline_timer = None
@@ -505,6 +525,19 @@ class FedMLServerManager(ServerManager):
             return
         self._last_broadcast_type = msg_type
         global_params = self.aggregator.get_global_model_params()
+        import time as _time
+
+        self._round_profiler.tick(self.round_idx)
+        if not self._round_span_open:
+            # one flight-recorder span per round, broadcast -> aggregate
+            # end (a zero-upload rebroadcast extends the same round)
+            self.telemetry.recorder.begin(
+                "cross_silo.round", cat="round", round=self.round_idx
+            )
+            self._round_span_open = True
+        self._bcast_t0 = _time.perf_counter()
+        self._upload_arrivals = {}
+        self._upload_train_s = {}
         expected = []
         self._round_assignment = {}
         for real_id, silo_idx in zip(selected_real_ids, silo_indexes):
@@ -516,6 +549,7 @@ class FedMLServerManager(ServerManager):
             msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo_idx)
             msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
             self.send_message(msg)
+        self._bcast_done_t = _time.perf_counter()
         self.aggregator.begin_round(expected)
         self._arm_deadline()
 
@@ -603,6 +637,18 @@ class FedMLServerManager(ServerManager):
                 "(now on round %d)", sender_rank, upload_round, self.round_idx,
             )
             return
+        import time as _time
+
+        # straggler analytics: when each upload landed and how much of
+        # that was the client's own training (self-reported). FIRST
+        # arrival wins — a network-duplicated copy of a fast client's
+        # upload landing late must not rename the straggler (the same
+        # rule the offline analyzer applies to duplicate flows)
+        if sender_rank not in self._upload_arrivals:
+            self._upload_arrivals[sender_rank] = _time.perf_counter()
+            reported_train_s = msg.get(constants.MSG_ARG_KEY_TRAIN_SECONDS)
+            if reported_train_s is not None:
+                self._upload_train_s[sender_rank] = float(reported_train_s)
         model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         if model_params is None:
             encoded = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
@@ -669,9 +715,14 @@ class FedMLServerManager(ServerManager):
         if self._wait_open:
             self.profiler.log_event_ended("server.wait")
             self._wait_open = False
+        import time as _time
+
         n_aggregated = self.aggregator.num_received()
+        t_agg0 = _time.perf_counter()
         if n_aggregated:
-            with self.profiler.span("aggregate"):
+            # the round tag lets the critical-path analyzer pick THIS
+            # round's aggregate span off the stitched timeline
+            with self.profiler.span("aggregate", round=self.round_idx):
                 self.aggregator.aggregate()
         else:
             # every expected client left before uploading (elastic):
@@ -680,6 +731,9 @@ class FedMLServerManager(ServerManager):
                 "round %d: no contributions (all expected clients left); "
                 "global model unchanged", self.round_idx,
             )
+        self._record_round_segments(
+            self.round_idx, _time.perf_counter() - t_agg0
+        )
         eval_round = self.round_idx
         cohort = self.aggregator.client_num  # before begin_round re-arms
         # the completed round's broadcast set, captured BEFORE the next
@@ -719,6 +773,62 @@ class FedMLServerManager(ServerManager):
             with self.profiler.span("server_eval_overlapped"):
                 self.aggregator.test_on_server_for_all_clients(eval_round)
         self._report_round(eval_round, cohort, n_aggregated)
+
+    def _record_round_segments(self, round_idx: int, aggregate_s: float) -> None:
+        """Live per-round critical-path attribution into the telemetry
+        registry (``round_segment_seconds{segment=...}``), straggler
+        analytics (slack histogram + rank gauge) and the SLO check
+        against ``round_deadline_s``. Server-observable times plus the
+        clients' self-reported ``train_seconds``; the stitched-trace
+        analyzer (``cli trace``) computes the exact cross-process
+        version offline."""
+        import time as _time
+
+        tel = self.telemetry
+        if self._round_span_open:
+            tel.recorder.end("cross_silo.round", cat="round", round=round_idx)
+            self._round_span_open = False
+        if self._bcast_t0 is None:
+            return
+        now = _time.perf_counter()
+        wall = now - self._bcast_t0
+        bcast_done = self._bcast_done_t or self._bcast_t0
+        segs = {
+            "broadcast_send": bcast_done - self._bcast_t0,
+            "aggregate": aggregate_s,
+        }
+        arrivals = self._upload_arrivals
+        if arrivals:
+            last = max(arrivals.values())
+            straggler = max(arrivals, key=arrivals.get)
+            wait = max(last - bcast_done, 0.0)
+            compute = self._upload_train_s.get(straggler)
+            if compute is not None:
+                segs["client_compute"] = min(compute, wait)
+                segs["wire"] = max(wait - compute, 0.0)
+            else:
+                segs["wire"] = wait
+            tel.set_gauge("round_straggler_rank", straggler)
+            # slack: how long each client's finished upload sat waiting
+            # on the straggler — the overlap budget items 3/4 of the
+            # roadmap (aggregate-on-arrival, PiPar) would reclaim
+            for rank, ts in arrivals.items():
+                tel.observe(
+                    "round_straggler_slack_s",
+                    max(last - ts, 0.0),
+                    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+                )
+        for name, dur in segs.items():
+            tel.observe("round_segment_seconds", max(dur, 0.0), segment=name)
+        tel.observe("round_wall_seconds", wall)
+        if self.round_deadline_s > 0 and wall > self.round_deadline_s:
+            tel.inc("slo_violations_total")
+            logging.warning(
+                "round %d violated round_deadline_s: %.3fs > %.3fs "
+                "(straggler rank %s)",
+                round_idx, wall, self.round_deadline_s,
+                max(arrivals, key=arrivals.get) if arrivals else "n/a",
+            )
 
     def _save_checkpoint(self) -> None:
         """step = the NEXT round to run; a restarted server picks up
@@ -774,7 +884,9 @@ class FedMLServerManager(ServerManager):
         logging.info("server: training finished after %d rounds", self.round_idx)
         if self._failure_detector is not None:
             self._failure_detector.stop()
+        self._round_profiler.close()
         self.telemetry.stop_watchdog()
+        self.telemetry.stop_metrics_server()
         self.telemetry.export_run_artifacts(
             getattr(self.args, "telemetry_dir", None)
         )

@@ -352,6 +352,12 @@ class FedAvgAPI:
         # args.stall_timeout_s > 0; observes the pipeline/comm
         # heartbeats and dumps a debug bundle to args.telemetry_dir
         watchdog = self.telemetry.maybe_start_watchdog(args)
+        # pull-based /metrics endpoint (off unless args.metrics_port)
+        # and on-demand per-round device profiling (args.profile_rounds)
+        self.telemetry.maybe_start_metrics_server(args)
+        from ..core.tracing import RoundProfiler
+
+        self._round_profiler = RoundProfiler(args)
         try:
             return self._train_rounds(
                 packed, nsamples, comm_rounds, freq, ckpt, start_round
@@ -359,8 +365,10 @@ class FedAvgAPI:
         finally:
             if ckpt is not None:
                 ckpt.close()
+            self._round_profiler.close()
             if watchdog is not None:
                 self.telemetry.stop_watchdog()
+            self.telemetry.stop_metrics_server()
             # one perfetto-loadable trace.json + registry exposition per
             # run when args.telemetry_dir is set
             self.telemetry.export_run_artifacts(
@@ -404,6 +412,8 @@ class FedAvgAPI:
         args = self.args
         final_stats: Dict[str, float] = {}
         for round_idx in range(start_round, comm_rounds):
+            if getattr(self, "_round_profiler", None) is not None:
+                self._round_profiler.tick(round_idx)
             t0 = time.perf_counter()
             idx = self._client_sampling(
                 round_idx, self.dataset.client_num, int(args.client_num_per_round)

@@ -180,6 +180,7 @@ GROUPS = [
         "grpc_ipconfig_path", "grpc_port_base", "fault_injection",
         "reliable_comm", "comm_retry_max", "comm_retry_base_s",
         "grpc_send_timeout_s", "heartbeat_interval_s", "heartbeat_timeout_s",
+        "round_deadline_s",
     ]),
     ("Defense", ["defense_type", "norm_bound", "stddev"]),
     ("Parallelism (mesh / distributed)", [
@@ -193,7 +194,8 @@ GROUPS = [
     ]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
-        "telemetry", "telemetry_dir", "stall_timeout_s",
+        "telemetry", "telemetry_dir", "stall_timeout_s", "trace_ring_size",
+        "profile_rounds", "metrics_port", "metrics_host",
     ]),
 ]
 

@@ -109,6 +109,7 @@ PHASES = [
     ("mesh", ["--phase", "mesh"], 240.0),
     ("telemetry", ["--phase", "telemetry"], 300.0),
     ("serving", ["--phase", "serving"], 300.0),
+    ("tracing", ["--phase", "tracing"], 300.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

@@ -171,19 +171,23 @@ class TestRegistry:
 
 
 def _check_trace_schema(payload):
-    """Valid Chrome trace JSON: known phases, monotonic ts, matched
-    B/E pairs per (tid, name)."""
+    """Valid Chrome trace JSON: known phases (incl. the tracing layer's
+    flow events and the stitcher's process metadata), monotonic ts,
+    matched B/E pairs per (tid, name)."""
     evs = payload["traceEvents"]
     assert evs, "empty trace"
-    for ev in evs:
-        assert ev["ph"] in ("B", "E", "i", "C"), ev
+    data = [e for e in evs if e["ph"] != "M"]
+    for ev in data:
+        assert ev["ph"] in ("B", "E", "i", "C", "s", "f"), ev
         for key in ("name", "cat", "ts", "pid", "tid"):
             assert key in ev, ev
+        if ev["ph"] in ("s", "f"):
+            assert "id" in ev, ev
     assert all(
-        evs[i]["ts"] <= evs[i + 1]["ts"] for i in range(len(evs) - 1)
+        data[i]["ts"] <= data[i + 1]["ts"] for i in range(len(data) - 1)
     ), "timestamps not monotonic"
     depth = {}
-    for ev in evs:
+    for ev in data:
         k = (ev["tid"], ev["name"])
         if ev["ph"] == "B":
             depth[k] = depth.get(k, 0) + 1
