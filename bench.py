@@ -80,7 +80,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
-    "telemetry", "serving", "chaos", "tracing",
+    "telemetry", "serving", "chaos", "tracing", "straggler",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -1220,6 +1220,380 @@ def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
+    """Straggler phase (docs/robustness.md "round-barrier failure
+    model"): four LOCAL cross-silo worlds proving the streaming
+    aggregate-on-arrival tentpole —
+
+    1. **buffered baseline** (``agg_mode=buffered``): clean run; peak
+       buffered uploads == cohort (the O(cohort x model) shape).
+    2. **sync streaming** (``agg_mode=stream``): same seed; final
+       params must be BIT-IDENTICAL to the baseline even though folds
+       happen in nondeterministic arrival order, and peak buffered
+       uploads is 0 — server aggregation memory is O(model).
+    3. **quorum mode**: one client 10x-delayed past the grace window
+       and one killed without OFFLINE (kill -9 analog, heartbeat
+       detector on): every round closes on the quorum, the corpse
+       leaves the quorum denominator, late uploads discard by round
+       tag, and round wall tracks quorum arrival — bounded well below
+       the blocked-on-straggler wall.
+    4. **async mode** (``agg_mode=async``): drop+dup+delay faults with
+       the reliable channel, the same 10x straggler and client kill,
+       plus one server crash right after a publish and a restart that
+       reseeds the fold ledger from the WAL. Every accepted update
+       folds EXACTLY once across both incarnations (telemetry counters
+       == the WAL's (rank, seq) ledger, pairwise distinct) and every
+       fold's staleness weight matches the unit oracle.
+
+    ``smoke`` (CI gate): 4 clients x 3 rounds on the LR mini cohort."""
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.aggregation import staleness_weight
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.data import load
+
+    n_clients = 4
+    rounds = 3 if (smoke or on_cpu) else 5
+    train_size = 240 if smoke else 400
+    delay_s = 6.0 if smoke else 10.0  # ~10x a typical mini round
+
+    def mk(rank, run_id, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = train_size
+        a.synthetic_test_size = 60
+        a.model = "lr"
+        a.partition_method = "hetero"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 1
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def build_world(run_id, **kw):
+        a0, ds0, m0 = mk(0, run_id, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, n_clients + 1):
+            a, ds, m = mk(r, run_id, **kw)
+            clients.append(Client(a, None, ds, m))
+        return server, clients
+
+    def run_clean(run_id, **kw):
+        Telemetry.reset()
+        server, clients = build_world(run_id, **kw)
+        threads = [
+            threading.Thread(target=c.run, daemon=True, name=f"{run_id}-c{i}")
+            for i, c in enumerate(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        server.run()
+        dt = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=120)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(f"{run_id}: threads hung: {hung}")
+        return server, dt
+
+    def max_diff(a, b):
+        return max(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda x, y: float(
+                        np.max(np.abs(np.asarray(x) - np.asarray(y)))
+                    ),
+                    a, b,
+                )
+            )
+        )
+
+    out = {"device": str(jax.devices()[0]), "clients": n_clients,
+           "rounds": rounds, "straggler_delay_s": delay_s}
+
+    # -- 1+2: buffered baseline vs sync streaming (bit-identity) ------
+    buffered, buf_dt = run_clean("bench_strag_buf", agg_mode="buffered")
+    _progress(f"straggler: buffered baseline done in {buf_dt:.1f}s")
+    streamed, str_dt = run_clean("bench_strag_str", agg_mode="stream")
+    _progress(f"straggler: streaming world done in {str_dt:.1f}s")
+    diff = max_diff(
+        buffered.aggregator.get_global_model_params(),
+        streamed.aggregator.get_global_model_params(),
+    )
+    out["max_abs_diff_stream_vs_buffered"] = diff
+    out["stream_identical_to_buffered"] = diff == 0.0
+    out["buffered_peak_buffered"] = buffered.aggregator.peak_buffered
+    out["stream_peak_buffered"] = streamed.aggregator.peak_buffered
+
+    # -- 3: quorum close with a 10x straggler + a kill ----------------
+    class _StragKill(Exception):
+        pass
+
+    Telemetry.reset()
+    qserver, qclients = build_world(
+        "bench_strag_quorum",
+        agg_mode="stream",
+        round_quorum_frac=0.5,
+        round_grace_s=1.0,
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=1.5,
+    )
+    drain = threading.Event()  # post-run: stop sleeping, drain fast
+    slow_trainer = qclients[2].trainer
+    orig_train = slow_trainer.train
+
+    def slow_train(params, round_idx):
+        drain.wait(delay_s)
+        return orig_train(params, round_idx)
+
+    slow_trainer.train = slow_train
+
+    victim = qclients[1]
+
+    def kill(msg):
+        if victim.manager._heartbeat is not None:
+            victim.manager._heartbeat.stop()
+        raise _StragKill()
+
+    victim.manager._train_and_send = kill
+
+    def qclient_thread(c):
+        try:
+            c.run()
+        except _StragKill:
+            pass
+
+    qthreads = [
+        threading.Thread(
+            target=qclient_thread, args=(c,), daemon=True, name=f"strag-q{i}"
+        )
+        for i, c in enumerate(qclients)
+    ]
+    t0 = time.perf_counter()
+    for t in qthreads:
+        t.start()
+    qserver.run()
+    quorum_wall = time.perf_counter() - t0
+    drain.set()
+    for t in qthreads:
+        t.join(timeout=120)
+    hung = [t.name for t in qthreads if t.is_alive()]
+    if hung:
+        raise RuntimeError(f"straggler quorum world: threads hung: {hung}")
+    qtel = Telemetry.get_instance()
+
+    def qtotal(counter):
+        return sum(qtel.counters_matching(counter).values())
+
+    blocked_bound = rounds * delay_s  # a barrier would wait this long
+    out["quorum"] = {
+        "rounds_completed": qserver.manager.round_idx,
+        "quorum_closes": qserver.manager.quorum_closes,
+        "stragglers_dropped": qserver.manager.stragglers_dropped,
+        "client_killed": True,
+        "deaths": qserver.manager.deaths,
+        "late_uploads_discarded": qtotal("agg_late_uploads_total"),
+        "wall_s": round(quorum_wall, 2),
+        "blocked_wall_bound_s": blocked_bound,
+        "tracks_quorum_not_straggler": quorum_wall < 0.75 * blocked_bound,
+        "peak_buffered": qserver.aggregator.peak_buffered,
+    }
+    _progress(
+        f"straggler: quorum world {quorum_wall:.1f}s vs blocked bound "
+        f"{blocked_bound:.0f}s ({qserver.manager.quorum_closes} quorum closes)"
+    )
+
+    # -- 4: async exactly-once under faults + kill + restart ----------
+    class _StragCrash(Exception):
+        pass
+
+    Telemetry.reset()
+    ckpt_dir = _tempfile.mkdtemp(prefix="bench_strag_ck_")
+    async_kw = dict(
+        agg_mode="async",
+        async_publish_every=2,
+        staleness_decay=0.5,
+        staleness_max=64,
+        reliable_comm=True,
+        comm_retry_max=8,
+        comm_retry_base_s=0.05,
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=60.0,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_freq=1,
+        fault_injection={
+            "drop_prob": 0.2,
+            "duplicate_prob": 0.2,
+            "delay_s": 0.05,
+            "delay_prob": 0.1,
+        },
+    )
+    aserver1, aclients = build_world("bench_strag_async", **async_kw)
+
+    adrain = threading.Event()
+    aslow = aclients[2].trainer
+    aorig = aslow.train
+
+    def aslow_train(params, round_idx):
+        adrain.wait(delay_s / 2.0)
+        return aorig(params, round_idx)
+
+    aslow.train = aslow_train
+
+    avictim = aclients[1]
+    akills = {"n": 0}
+    aorig_tas = avictim.manager._train_and_send
+
+    def akill_or_train(msg):
+        akills["n"] += 1
+        if akills["n"] >= 2:
+            if avictim.manager._heartbeat is not None:
+                avictim.manager._heartbeat.stop()
+            raise _StragKill()
+        aorig_tas(msg)
+
+    avictim.manager._train_and_send = akill_or_train
+
+    crashed = threading.Event()
+    amgr1 = aserver1.manager
+    orig_publish = amgr1._async_publish
+
+    def publish_then_crash():
+        orig_publish()
+        if amgr1.version >= 2 and not crashed.is_set():
+            if amgr1._failure_detector is not None:
+                amgr1._failure_detector.stop()
+            crashed.set()
+            raise _StragCrash()
+
+    amgr1._async_publish = publish_then_crash
+
+    def aclient_thread(c):
+        try:
+            c.run()
+        except _StragKill:
+            pass
+
+    athreads = [
+        threading.Thread(
+            target=aclient_thread, args=(c,), daemon=True, name=f"strag-a{i}"
+        )
+        for i, c in enumerate(aclients)
+    ]
+    t0 = time.perf_counter()
+    for t in athreads:
+        t.start()
+
+    def aserver_thread():
+        try:
+            aserver1.run()
+        except _StragCrash:
+            pass
+
+    ast = threading.Thread(target=aserver_thread, daemon=True, name="strag-asrv1")
+    ast.start()
+    if not crashed.wait(timeout=240):
+        raise RuntimeError("straggler: async server crash never triggered")
+    ast.join(timeout=120)
+    _progress("straggler: async server crashed after a publish; restarting")
+    a0b, ds0b, m0b = mk(0, "bench_strag_async", **async_kw)
+    aserver2 = Server(a0b, None, ds0b, m0b)
+    amgr2 = aserver2.manager
+    resumed_version = amgr2.version
+    folded_before = set((e["rank"], e["seq"]) for e in amgr1.async_weight_log)
+    aserver2.run()
+    async_wall = time.perf_counter() - t0
+    adrain.set()
+    for t in athreads:
+        t.join(timeout=180)
+    hung = [t.name for t in athreads if t.is_alive()]
+    if hung:
+        raise RuntimeError(f"straggler async world: threads hung: {hung}")
+
+    atel = Telemetry.get_instance()
+
+    def atotal(counter):
+        return sum(atel.counters_matching(counter).values())
+
+    # exactly-once ledger: WAL publish records across BOTH incarnations
+    wal_pairs = []
+    for rec in amgr2._wal.records():
+        if rec.get("kind") == "publish":
+            wal_pairs.extend(tuple(p) for p in rec.get("folded") or [])
+    folded_after = set((e["rank"], e["seq"]) for e in amgr2.async_weight_log)
+    weight_oracle_ok = all(
+        abs(
+            e["weight"]
+            - staleness_weight(
+                e["sample_num"], e["staleness"], amgr2.staleness_decay
+            )
+        ) <= 1e-12 * max(1.0, abs(e["weight"]))
+        for e in list(amgr1.async_weight_log) + list(amgr2.async_weight_log)
+    )
+    stale_folds = sum(
+        1
+        for e in list(amgr1.async_weight_log) + list(amgr2.async_weight_log)
+        if e["staleness"] > 0
+    )
+    out["async"] = {
+        "folds_total": amgr2.async_folds,
+        "target_folds": amgr2._async_target_folds(),
+        "publishes": amgr2.version,
+        "server_restarted": crashed.is_set(),
+        "resumed_at_version": resumed_version,
+        "client_killed": akills["n"] >= 2,
+        "wal_folded_pairs": len(wal_pairs),
+        "double_folds": len(wal_pairs) - len(set(wal_pairs)),
+        "refolded_across_restart": len(folded_before & folded_after),
+        "folds_counter_total": atotal("agg_folds_total"),
+        "exactly_once": (
+            len(wal_pairs) == len(set(wal_pairs))
+            and not (folded_before & folded_after)
+            and atotal("agg_folds_total") == len(wal_pairs)
+            and amgr2.async_folds >= amgr2._async_target_folds()
+        ),
+        "stale_folds": stale_folds,
+        "staleness_weights_match_oracle": weight_oracle_ok,
+        "superseded_discards": atotal("agg_async_superseded_total"),
+        "stale_discards": atotal("agg_stale_discarded_total"),
+        "dup_dropped_total": atotal("comm_dup_dropped_total"),
+        "retries_total": atotal("comm_retries_total"),
+        "wall_s": round(async_wall, 2),
+    }
+    _progress(
+        f"straggler: async {amgr2.async_folds}/{amgr2._async_target_folds()} "
+        f"folds, {amgr2.version} publishes, "
+        f"{out['async']['double_folds']} double folds"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
     """Tracing phase (docs/observability.md): a LOCAL multi-client
     cross-silo world run twice — telemetry OFF, then distributed
@@ -1592,6 +1966,10 @@ _CHAOS_TIMEOUT_S = 300.0
 # two LOCAL worlds (telemetry off vs tracing on) + stitch/analyze +
 # a mini pipelined off/on pair for the host-sync identity figure
 _TRACING_TIMEOUT_S = 300.0
+# four LOCAL worlds (buffered, stream, quorum with a 10x straggler,
+# async with faults + kill + restart); the quorum world deliberately
+# waits out grace windows and the async drain rides the straggler
+_STRAGGLER_TIMEOUT_S = 360.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -1867,6 +2245,12 @@ def _main_guarded() -> None:
     # vs telemetry-off, host-syncs identity — observability as a
     # measured contract
     _run_demoted_phase("tracing", _TRACING_TIMEOUT_S)
+    # straggler phase (streaming aggregate-on-arrival): sync-streaming
+    # bit-identical to the buffered baseline at O(model) memory,
+    # quorum rounds tracking quorum arrival (not the 10x straggler),
+    # async exactly-once folds with oracle-checked staleness weights
+    # under faults + kill + server restart
+    _run_demoted_phase("straggler", _STRAGGLER_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -2010,6 +2394,8 @@ def _phase_main(argv) -> None:
         out = run_chaos(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "tracing":
         out = run_tracing(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "straggler":
+        out = run_straggler(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

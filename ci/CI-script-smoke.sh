@@ -44,6 +44,19 @@ python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_chaos_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
 
+# Straggler smoke (4 clients x 3 rounds, CPU): the streaming
+# aggregate-on-arrival tentpole must run end-to-end through bench.py's
+# straggler phase child and emit the detail.straggler contract keys —
+# sync-streaming final params bit-identical to the buffered baseline
+# with server aggregation memory O(model), quorum rounds closing on
+# quorum arrival past a 10x-delayed straggler and a killed client, and
+# async mode folding every accepted update exactly once (WAL ledger ==
+# telemetry counters) with oracle-matched staleness weights under
+# drop/dup/delay faults and a server restart.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_straggler_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider
+
 # Tracing smoke (3 clients x 6 rounds, ABBA off/on worlds, CPU): the
 # distributed-tracing layer must run end-to-end through bench.py's
 # tracing phase child and emit the detail.tracing contract keys —

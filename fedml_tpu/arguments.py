@@ -69,6 +69,35 @@ _DEFAULTS: Dict[str, Any] = {
     # path, driven by the async round pipeline) or "sequential"
     # (python loop per client — the reference's shape, debug/parity)
     "sim_mode": "vectorized",
+    # server aggregation mode (core/aggregation.py StreamingAccumulator
+    # + cross_silo managers): "stream" folds each upload into O(model)
+    # running accumulators the moment it lands (bit-identical results
+    # to "buffered"; falls back to the buffered path LOUDLY when the
+    # aggregation needs the full cohort at once, e.g. defense_type or a
+    # custom ServerAggregator); "buffered" keeps the reference's
+    # buffer-then-aggregate shape; "async" is the FedBuff-style mode:
+    # no round barrier, staleness-weighted folds, a publish every
+    # async_publish_every folds
+    "agg_mode": "stream",
+    # quorum round close (streaming modes): once this fraction of the
+    # round's live cohort has folded, arm a round_grace_s timer; when
+    # it fires the round closes over the partial cohort (weights
+    # renormalize) and late uploads are discarded by round tag. Ranks
+    # the failure detector declares dead leave the quorum denominator.
+    # 0 disables (wait for everyone, the reference shape)
+    "round_quorum_frac": 0.0,
+    # how long past quorum the server keeps waiting for stragglers
+    "round_grace_s": 0.0,
+    # async staleness weighting: an upload trained against a model s
+    # publishes old folds with weight sample_num * staleness_decay^s
+    "staleness_decay": 0.5,
+    # async hard staleness cap: updates staler than this are discarded
+    # (counted agg_stale_discarded_total), never folded
+    "staleness_max": 10,
+    # async publish cadence: finalize + publish the global model (and
+    # checkpoint it when checkpoint_dir is set, feeding the serving
+    # plane's hot-swap watcher) every K folds
+    "async_publish_every": 4,
     # straggler handling (cross-silo; beyond the reference): aggregate
     # whoever reported within this many seconds of the round broadcast,
     # reweighted over the subset. 0 = wait for everyone (reference).
@@ -391,6 +420,48 @@ class Arguments:
         if self.grpc_send_timeout_s <= 0:
             raise ValueError(
                 f"grpc_send_timeout_s={self.grpc_send_timeout_s}: must be > 0"
+            )
+        if getattr(self, "agg_mode", "stream") not in (
+            "stream", "buffered", "async",
+        ):
+            raise ValueError(
+                f"agg_mode {self.agg_mode!r}: pick 'stream' (aggregate-on-"
+                "arrival), 'buffered' (reference shape) or 'async' (FedBuff)"
+            )
+        for float_key in ("round_quorum_frac", "round_grace_s", "staleness_decay"):
+            setattr(self, float_key, float(getattr(self, float_key)))
+        if not 0.0 <= self.round_quorum_frac <= 1.0:
+            raise ValueError(
+                f"round_quorum_frac={self.round_quorum_frac}: must be in "
+                "[0, 1] (0 disables the quorum close)"
+            )
+        if self.round_grace_s < 0:
+            raise ValueError(
+                f"round_grace_s={self.round_grace_s}: must be >= 0"
+            )
+        if not 0.0 < self.staleness_decay <= 1.0:
+            raise ValueError(
+                f"staleness_decay={self.staleness_decay}: must be in (0, 1] "
+                "(1 = no staleness discount)"
+            )
+        for int_key in ("staleness_max", "async_publish_every"):
+            setattr(self, int_key, int(getattr(self, int_key)))
+        if self.staleness_max < 0:
+            raise ValueError(
+                f"staleness_max={self.staleness_max}: must be >= 0 "
+                "(0 = only fresh updates fold)"
+            )
+        if self.async_publish_every < 1:
+            raise ValueError(
+                f"async_publish_every={self.async_publish_every}: must be >= 1"
+            )
+        if (
+            getattr(self, "agg_mode", "stream") == "async"
+            and float(getattr(self, "aggregation_deadline_s", 0) or 0) > 0
+        ):
+            raise ValueError(
+                "agg_mode=async has no round barrier; "
+                "aggregation_deadline_s does not apply — unset one of them"
             )
         if self.serve_queue_size < 1 or self.serve_max_batch < 1:
             raise ValueError(

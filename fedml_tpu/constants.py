@@ -97,6 +97,11 @@ MSG_TYPE_S2S_AGG_DEADLINE = 30
 # to the server's own inbox so membership mutation stays on the single
 # dispatch thread, same pattern as the deadline loopback)
 MSG_TYPE_S2S_CLIENT_DEAD = 31
+# server-internal: the quorum grace timer fired (streaming aggregation,
+# round_quorum_frac/round_grace_s — once a quorum of uploads has folded
+# and the grace elapses, the round closes over the partial cohort; same
+# loopback pattern as the deadline)
+MSG_TYPE_S2S_QUORUM_GRACE = 32
 
 # Serving plane (fedml_tpu/serving — beyond the reference, which ships
 # trained models to an external MLOps tier): one request/response pair
@@ -131,3 +136,12 @@ MSG_ARG_KEY_TRACE_ID = "trace_id"
 MSG_ARG_KEY_TRACE_SPAN = "trace_span"
 MSG_ARG_KEY_TRACE_FLOW = "trace_flow"
 MSG_ARG_KEY_TRAIN_SECONDS = "train_seconds"
+
+# Async (FedBuff-style) aggregation protocol (agg_mode=async — beyond
+# the reference): the server never barriers on a cohort. Each downlink
+# carries the publish VERSION its params came from; the client echoes
+# it on the upload so the server can staleness-discount the update
+# (``staleness_decay^(current - base)``). ``ROUND_INDEX`` doubles as a
+# per-dispatch sequence id in async mode, which is what makes folds
+# exactly-once attributable across retransmits and server restarts.
+MSG_ARG_KEY_MODEL_VERSION = "model_version"

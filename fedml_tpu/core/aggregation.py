@@ -18,10 +18,12 @@ by the vmap simulator (client axis = vmap axis) and the mesh simulator
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Params = Any  # pytree of jax.Array
 
@@ -112,6 +114,212 @@ def _stacked_norms(stacked: Params) -> jax.Array:
     leaves = jax.tree.leaves(stacked)
     sq = sum(jnp.sum(jnp.square(l.reshape(l.shape[0], -1)), axis=1) for l in leaves)
     return jnp.sqrt(sq)
+
+
+# ---------------------------------------------------------------------
+# Streaming aggregate-on-arrival (ROADMAP items 3/5)
+# ---------------------------------------------------------------------
+#
+# The buffered server stacks the whole cohort before reducing —
+# O(cohort x model) memory and the reduce runs only after the slowest
+# client reports. The streaming fold below accumulates each upload the
+# moment it lands, in O(model) memory, and is ORDER-INDEPENDENT at the
+# bit level: two worlds whose uploads arrive in different thread orders
+# finalize to identical float32 params. That property is what lets the
+# straggler bench assert sync-streaming == buffered baseline
+# bit-for-bit even though arrival order is nondeterministic.
+#
+# Order independence comes from an error-free transformation split
+# into two jitted executables:
+#
+# 1. the TERM step rounds each upload's contribution once —
+#    ``t = fl32(w * theta)`` (for quantized uplinks: decode +
+#    reconstruct + weight in one fused step). Whatever FMA contraction
+#    or fusion XLA applies inside it is fine: the step is a pure
+#    function of (upload, w), so its bits are identical no matter when
+#    the upload arrives — and the buffered fallback routes through the
+#    SAME executable, which is what makes buffered == streaming
+#    bit-for-bit.
+# 2. the FOLD step accumulates terms into a 3-limb float32 expansion
+#    with Knuth two-sums. It contains only adds/subtracts — no multiply
+#    exists for XLA to contract into an FMA — so every add is exact
+#    except the lowest limb's, and reorderings agree to ~2^-60
+#    relative, far below float32's 2^-24 rounding boundary at finalize.
+#
+# The two steps MUST stay separate executables: measured on this
+# jaxlib, XLA:CPU contracts ``s + w*x`` into ``fma(w, x, s)`` whenever
+# both live in one computation (optimization_barrier and
+# reduce_precision do not prevent it), which silently re-introduces
+# arrival-order dependence at full float32 ulp scale.
+
+
+def _two_sum(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Knuth two-sum: s + e == a + b exactly (IEEE round-to-nearest);
+    branch-free, valid for any magnitudes."""
+    s = a + b
+    v = s - a
+    e = (a - (s - v)) + (b - v)
+    return s, e
+
+
+def _fold_leaf(s0, s1, s2, t):
+    s0, e = _two_sum(s0, t)
+    s1, e = _two_sum(s1, e)
+    s2 = s2 + e  # only inexact add; error ~2^-48 of the term
+    return s0, s1, s2
+
+
+@jax.jit
+def _fold_tree(limbs, term: Params):
+    """Exact expansion fold of an already-weighted term tree. Adds
+    only — keep any multiply (term computation) OUT of this jit, or
+    XLA's FMA contraction breaks the error-free transformation."""
+    s0, s1, s2 = limbs
+    out = jax.tree.map(_fold_leaf, s0, s1, s2, term)
+    # tree-of-triples -> triple-of-trees (transpose keeps arbitrary
+    # model pytrees — including ones that themselves contain tuples —
+    # out of harm's way)
+    return jax.tree.transpose(
+        jax.tree.structure(term), jax.tree.structure((0, 0, 0)), out
+    )
+
+
+@jax.jit
+def _weighted_term(theta: Params, w: jax.Array) -> Params:
+    """t = w * theta, rounded once per upload — deterministic per
+    (theta, w) regardless of arrival order."""
+    return jax.tree.map(lambda x: w * x.astype(jnp.float32), theta)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _weighted_term_encoded(codec, encoded, like: Params, w: jax.Array) -> Params:
+    """Fused decompress + reconstruct + weight: decode the wire payload
+    against the pre-round global tree and produce the weighted term in
+    one jitted step — the quantized buffers never materialize a second
+    full-precision host copy. ``codec`` is a static arg (one trace per
+    codec instance); both the streaming and the buffered paths call
+    THIS executable, so their terms agree bitwise."""
+    from .compression import decode_delta
+
+    delta = decode_delta(codec, encoded, like)
+    return jax.tree.map(
+        lambda g, d: w * (g.astype(jnp.float32) + d.astype(jnp.float32)),
+        like,
+        delta,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _weighted_term_decoded(codec, encoded, like: Params, w: jax.Array) -> Params:
+    """Fused decompress + weight of an update DELTA (async mode folds
+    deltas, never full models — the server does not keep the stale base
+    params a staleness>0 client trained from). ``like`` supplies
+    shapes only (topk scatter)."""
+    from .compression import decode_delta
+
+    delta = decode_delta(codec, encoded, like)
+    return jax.tree.map(lambda d: w * d.astype(jnp.float32), delta)
+
+
+class StreamingAccumulator:
+    """Incremental weighted-sum fold over model uploads: O(model)
+    memory, order-independent finalize.
+
+    ``fold(theta, w)`` the moment an upload lands; ``finalize()`` once
+    the round closes returns ``sum_i w_i * theta_i / sum_i w_i`` as the
+    template's dtype — weights renormalize over whatever was folded, so
+    a quorum-closed partial cohort needs no special casing. The
+    buffered path folds its sorted buffer through this same class,
+    which is what makes buffered and streaming bit-identical.
+    """
+
+    def __init__(self, template: Params) -> None:
+        self._template = template
+        self.reset()
+
+    def fold(self, theta: Params, w: float) -> None:
+        self._fold_term(_weighted_term(theta, jnp.float32(w)), w)
+
+    def fold_encoded(self, codec, encoded: Params, like: Params, w: float) -> None:
+        """Fold a compressed upload: decode + reconstruct + weight in
+        one fused jitted step against the pre-round global tree."""
+        self._fold_term(
+            _weighted_term_encoded(codec, encoded, like, jnp.float32(w)), w
+        )
+
+    def fold_encoded_delta(
+        self, codec, encoded: Params, like: Params, w: float
+    ) -> None:
+        """Fold a compressed update DELTA without reconstructing a full
+        model (async mode; ``like`` supplies shapes only)."""
+        self._fold_term(
+            _weighted_term_decoded(codec, encoded, like, jnp.float32(w)), w
+        )
+
+    def _fold_term(self, term: Params, w: float) -> None:
+        self._limbs = _fold_tree(self._limbs, term)
+        # float32 first (the term used fl32(w)); python-float sums of
+        # integer sample counts are exact in any order
+        self.total_w += float(jnp.float32(w))
+        self.count += 1
+
+    def finalize(self) -> Params:
+        """Weighted average of everything folded so far. The limb sums
+        collapse on host in extended precision (longdouble where the
+        platform has it) so the final float32 rounding sees the exact
+        expansion value — the one place a digit of precision could
+        leak order back in."""
+        if self.count == 0:
+            raise RuntimeError("finalize() with no folded uploads")
+        s0, s1, s2 = self._limbs
+        wide = np.longdouble  # x86-64: 80-bit; elsewhere degrades to f64
+        w_total = wide(self.total_w)
+
+        def leaf(a0, a1, a2, t):
+            acc = (
+                np.asarray(a0, dtype=wide)
+                + np.asarray(a1, dtype=wide)
+                + np.asarray(a2, dtype=wide)
+            )
+            out = (acc / w_total).astype(np.float32)
+            return jnp.asarray(out, dtype=t.dtype)
+
+        return jax.tree.map(leaf, s0, s1, s2, self._template)
+
+    def reset(self) -> None:
+        zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda x: jnp.zeros(jnp.shape(x), jnp.float32), self._template
+        )
+        self._limbs = (zeros(), zeros(), zeros())
+        # python float: sample counts are integers, exactly summed in
+        # float64 in any order; async staleness weights make no
+        # bit-identity claim
+        self.total_w = 0.0
+        self.count = 0
+
+
+def staleness_weight(sample_num: float, staleness: int, decay: float) -> float:
+    """FedBuff-style staleness discount: an update trained against a
+    model ``staleness`` publishes old contributes ``n * decay^s`` —
+    the unit oracle the async tests and bench pin against."""
+    if staleness < 0:
+        raise ValueError(f"staleness must be >= 0, got {staleness}")
+    return float(sample_num) * float(decay) ** int(staleness)
+
+
+def needs_full_cohort(args, server_aggregator) -> Optional[str]:
+    """Why streaming aggregation cannot serve this config, or None.
+
+    The incremental fold is a weighted sum; an aggregator that needs
+    the whole cohort at once (coordinate-wise median, a custom
+    ``ServerAggregator`` reduction, norm-clipping against per-client
+    deltas) must keep the buffered path — loudly, never silently."""
+    if server_aggregator is not None:
+        return "custom ServerAggregator reduces over the stacked cohort"
+    defense = getattr(args, "defense_type", None)
+    if defense:
+        return f"defense_type={defense} needs the full cohort at once"
+    return None
 
 
 class RobustAggregator:

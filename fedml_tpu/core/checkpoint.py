@@ -115,11 +115,14 @@ class RoundWAL:
     """Append-only write-ahead log of COMPLETED federation rounds.
 
     One JSONL record per completed round next to the orbax steps:
-    ``{"round_idx", "ckpt_step", "cohort"}`` — which round finished,
-    which checkpoint step (if any) carries its aggregated params, and
-    which client ranks the round was broadcast to. The orbax checkpoint
-    holds the heavy state (params); the WAL holds the narrative a
-    restarted server needs to know WHERE it is:
+    ``{"round_idx", "ckpt_step", "cohort", "folded"}`` — which round
+    finished, which checkpoint step (if any) carries its aggregated
+    params, which client ranks the round was broadcast to, and which
+    ranks' uploads were actually FOLDED into the aggregate (under a
+    quorum/deadline close the folded set is a strict subset of the
+    cohort). The orbax checkpoint holds the heavy state (params); the
+    WAL holds the narrative a restarted server needs to know WHERE it
+    is:
 
     - ``last()`` after a crash names the last round that actually
       completed; when ``checkpoint_freq > 1`` that can be AHEAD of the
@@ -127,7 +130,16 @@ class RoundWAL:
       aggregates were lost with the process) is detected and logged
       loudly instead of silently retraining;
     - the cohort record makes post-mortems concrete ("round 41 was
-      waiting on ranks {2,5} when the server died").
+      waiting on ranks {2,5} when the server died");
+    - the folded set is the exactly-once ledger: a restarted server
+      knows which uploads are already inside the restored params, so
+      it neither double-folds a retransmitted one nor silently drops a
+      round's partial accumulator (mid-round folds die with the
+      process by design — the round restarts whole via RESYNC, so no
+      contribution is half-applied). Async mode (``kind="publish"``)
+      leans on this hardest: its records carry the folded
+      ``(rank, seq)`` pairs per publish plus the dispatch-sequence
+      high-water mark the resumed server must not reuse.
 
     Durability: each append is one ``write + flush + fsync``; ``last``
     / ``records`` tolerate a torn final line (a server killed
@@ -149,12 +161,25 @@ class RoundWAL:
         round_idx: int,
         ckpt_step: Optional[int],
         cohort: List[int],
+        folded: Optional[List] = None,
+        kind: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
     ) -> None:
         rec = {
             "round_idx": int(round_idx),
             "ckpt_step": None if ckpt_step is None else int(ckpt_step),
             "cohort": sorted(int(r) for r in cohort),
         }
+        if folded is not None:
+            # ranks (sync rounds) or [rank, seq] pairs (async publishes)
+            rec["folded"] = sorted(
+                [int(r[0]), int(r[1])] if isinstance(r, (list, tuple)) else int(r)
+                for r in folded
+            )
+        if kind is not None:
+            rec["kind"] = str(kind)
+        if extra:
+            rec.update(extra)
         # a previous crash mid-append can leave a torn, newline-less
         # final line; start fresh so the new record never concatenates
         # onto it (the torn fragment stays skippable on read)

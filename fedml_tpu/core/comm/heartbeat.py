@@ -116,6 +116,16 @@ class FailureDetector:
         with self._lock:
             self._last[int(rank)] = time.monotonic()
 
+    def last_seen_age_s(self, rank: int) -> Optional[float]:
+        """Seconds since the last traffic from ``rank`` (None = never
+        seen). The quorum close logs this per missing rank so an
+        operator can tell a slow-but-alive straggler (small age) from a
+        rank the detector is about to declare dead (age near the
+        timeout) without waiting for the declaration."""
+        with self._lock:
+            last = self._last.get(int(rank))
+        return None if last is None else max(time.monotonic() - last, 0.0)
+
     def seen_recently(self, rank: int) -> bool:
         """True when ``rank`` produced traffic within the timeout —
         the declaration handler's race check (a message may already

@@ -171,6 +171,16 @@ def decode_delta(codec, encoded: Params, like: Params) -> Params:
     return codec.decode(encoded)
 
 
+def reconstruct_from_encoded(codec, encoded: Params, like: Params) -> Params:
+    """``like + decode(encoded)`` — the full reconstructed model a
+    buffered/full-cohort aggregation path needs. The streaming fold
+    never calls this: it fuses decode + reconstruct + weighting into
+    one jitted step (``core.aggregation._weighted_term_encoded``) so no
+    second full-precision copy materializes per upload."""
+    delta = decode_delta(codec, encoded, like)
+    return jax.tree.map(jnp.add, like, delta)
+
+
 def encoded_nbytes(encoded: Params) -> int:
     """Wire size of an encoded payload (sum of leaf buffer bytes)."""
     return int(

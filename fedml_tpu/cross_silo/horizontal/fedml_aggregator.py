@@ -4,23 +4,38 @@ Parity with ``python/fedml/cross_silo/horizontal/fedml_aggregator.py:15-153``:
 collect per-client results, check-all-received, weighted aggregate, the
 ``data_silo_selection`` / ``client_selection`` split that lets N real
 edge devices map onto M data silos, and deterministic per-round
-sampling. Aggregation itself is the on-device pytree reduction from
-``core.aggregation`` (the reference loops over python dicts on host).
+sampling.
+
+**Beyond the reference — streaming aggregate-on-arrival** (ROADMAP
+items 3/5): with ``agg_mode: stream`` (the default) each upload is
+folded into O(model) running accumulators the moment it lands
+(``core.aggregation.StreamingAccumulator``): server memory stops
+scaling with the cohort and the post-barrier aggregate shrinks to a
+finalize. The fold is bit-order-independent, so streaming results are
+bit-identical to ``agg_mode: buffered`` (which routes its sorted
+buffer through the same fold). Aggregations that need the whole cohort
+at once — ``defense_type`` or a custom ``ServerAggregator`` — fall
+back to the buffered path LOUDLY: one warning plus the
+``agg_stream_fallback_total`` counter, never a silent wrong answer.
+``agg_mode: async`` (FedBuff-style, see the server manager) folds with
+staleness-discounted weights through the same accumulator and never
+clears a cohort barrier at all.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...core.aggregation import (
+    StreamingAccumulator,
+    needs_full_cohort,
     normalize_weights,
     stack_pytrees,
-    weighted_average,
 )
 from ...core.frame import bind_operator
 from ...core.local_trainer import compute_dtype_from_args, make_eval_fn
@@ -37,7 +52,6 @@ class FedMLAggregator:
         self._agg_round = 0
         self.client_num = int(args.client_num_per_round)
         self._expected = None  # set per round via begin_round (elastic)
-        self.model_dict: Dict[int, Params] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
         # same init-rng convention as the simulators (FedAvgAPI.__init__)
@@ -52,6 +66,39 @@ class FedMLAggregator:
                 compute_dtype=compute_dtype_from_args(args),
             )
         )
+        # -- aggregation mode (streaming tentpole) ---------------------
+        from ...core.compression import make_codec
+        from ...core.telemetry import Telemetry
+
+        self._tel = Telemetry.get_instance(args)
+        self._codec = make_codec(args)
+        self.agg_mode = str(getattr(args, "agg_mode", "stream"))
+        self._fallback_reason = needs_full_cohort(args, self.server_aggregator)
+        if self.agg_mode == "async" and self._fallback_reason:
+            raise ValueError(
+                "agg_mode=async requires the incremental fold but "
+                f"{self._fallback_reason}; use agg_mode=buffered with a "
+                "synchronous round loop instead"
+            )
+        self.streaming = (
+            self.agg_mode in ("stream", "async") and self._fallback_reason is None
+        )
+        if self.agg_mode == "stream" and self._fallback_reason is not None:
+            # loud one-time fallback (satellite contract): the operator
+            # asked for streaming and is getting the buffered path
+            logging.warning(
+                "agg_mode=stream falling back to the BUFFERED aggregation "
+                "path: %s (counted in agg_stream_fallback_total)",
+                self._fallback_reason,
+            )
+            self._tel.inc("agg_stream_fallback_total")
+        self._acc: Optional[StreamingAccumulator] = None
+        # encoded/raw payloads awaiting a buffered aggregate; streaming
+        # never populates it (that is the whole point)
+        self._pending: Dict[int, Tuple[str, Params, float]] = {}
+        self._folded: Set[int] = set()
+        self.peak_buffered = 0  # max simultaneous buffered uploads (O(model) proof)
+        self.folds_total = 0  # lifetime incremental folds (exactly-once evidence)
 
     def get_global_model_params(self) -> Params:
         return self.global_params
@@ -59,10 +106,26 @@ class FedMLAggregator:
     def set_global_model_params(self, params: Params) -> None:
         self.global_params = params
 
-    def add_local_trained_result(
-        self, index: int, model_params: Params, sample_num: float
+    def _accumulator(self) -> StreamingAccumulator:
+        if self._acc is None:
+            self._acc = StreamingAccumulator(self.global_params)
+        return self._acc
+
+    def receive_upload(
+        self,
+        index: int,
+        sample_num: float,
+        model_params: Optional[Params] = None,
+        encoded: Optional[Params] = None,
+        weight_scale: float = 1.0,
     ) -> None:
-        """(fedml_aggregator.py:58-63)
+        """One client upload landed: fold it NOW (streaming/async) or
+        buffer it (buffered / full-cohort fallback).
+
+        Exactly one of ``model_params`` (full tree) / ``encoded``
+        (compressed delta against the current global tree) is given.
+        ``weight_scale`` discounts the sample weight — 1.0 in sync
+        modes, the staleness decay factor in async mode.
 
         Incoming trees may live on a client-private device subset (a
         hierarchical silo's DP mesh, where params are replicated) —
@@ -73,10 +136,88 @@ class FedMLAggregator:
         need a sharded server aggregation path instead of this."""
         from ...core.aggregation import reconcile_to_device
 
-        model_params = reconcile_to_device(model_params)
-        self.model_dict[index] = model_params
+        if index in self._folded:
+            # at-least-once delivery without the reliable channel's
+            # dedup: the buffered dict was naturally idempotent
+            # (overwrite); the incremental fold must enforce at-most-
+            # once per (rank, round) itself or a duplicate folds twice
+            self._tel.inc("agg_dup_uploads_ignored_total")
+            logging.info(
+                "duplicate upload from index %d ignored (already folded "
+                "this round)", index,
+            )
+            return
+        payload = model_params if model_params is not None else encoded
+        payload = reconcile_to_device(payload)
+        w = float(sample_num) * float(weight_scale)
+        if self.streaming:
+            if model_params is not None:
+                self._accumulator().fold(payload, w)
+            else:
+                self._accumulator().fold_encoded(
+                    self._codec, payload, self.global_params, w
+                )
+            self.folds_total += 1
+            self._tel.inc("agg_folds_total", mode=self.agg_mode)
+        else:
+            self._pending[index] = (
+                "raw" if model_params is not None else "enc", payload, w,
+            )
+            self.peak_buffered = max(self.peak_buffered, len(self._pending))
+            self._tel.set_gauge("agg_peak_buffered", self.peak_buffered)
+        self._folded.add(index)
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
+
+    def add_local_trained_result(
+        self, index: int, model_params: Params, sample_num: float
+    ) -> None:
+        """(fedml_aggregator.py:58-63) — legacy entry point; routes
+        through ``receive_upload``."""
+        self.receive_upload(index, sample_num, model_params=model_params)
+
+    # -- async (FedBuff-style) fold/publish ---------------------------
+    def fold_delta(
+        self,
+        sample_num: float,
+        delta: Optional[Params] = None,
+        encoded: Optional[Params] = None,
+        weight_scale: float = 1.0,
+    ) -> None:
+        """Fold a staleness-discounted update DELTA (async mode). The
+        server applies deltas to whatever the global model is NOW —
+        it never stores the stale base params the client trained from,
+        which is what keeps async memory O(model) at any staleness."""
+        from ...core.aggregation import reconcile_to_device
+
+        payload = delta if delta is not None else encoded
+        payload = reconcile_to_device(payload)
+        w = float(sample_num) * float(weight_scale)
+        if delta is not None:
+            self._accumulator().fold(payload, w)
+        else:
+            self._accumulator().fold_encoded_delta(
+                self._codec, payload, self.global_params, w
+            )
+        self.folds_total += 1
+        self._tel.inc("agg_folds_total", mode=self.agg_mode)
+
+    def pending_folds(self) -> int:
+        return 0 if self._acc is None else self._acc.count
+
+    def publish_async(self) -> Params:
+        """Close the async buffer: global += weighted-mean folded delta
+        (the finalize divides by the folded staleness-discounted
+        weights). A no-op when nothing folded since the last publish."""
+        if self.pending_folds() == 0:
+            return self.global_params
+        mean_delta = self._acc.finalize()
+        self.global_params = jax.tree.map(
+            lambda g, d: g + d.astype(g.dtype), self.global_params, mean_delta
+        )
+        self._agg_round += 1
+        self._reset_window()
+        return self.global_params
 
     def check_whether_all_receive(self) -> bool:
         """(fedml_aggregator.py:65-71)"""
@@ -93,7 +234,19 @@ class FedMLAggregator:
         return True
 
     def num_received(self) -> int:
-        return len(self.model_dict)
+        return len(self._folded)
+
+    def folded_indexes(self) -> List[int]:
+        """Aggregator indexes (rank-1) folded/buffered into the round
+        so far — the WAL's per-round folded-set record."""
+        return sorted(self._folded)
+
+    def missing_indexes(self) -> List[int]:
+        """Expected indexes that have not folded yet (the quorum
+        close's straggler report)."""
+        if self._expected is None:
+            return []
+        return sorted(set(self._expected) - self._folded)
 
     def drop_expected(self, index: int) -> bool:
         """Remove a leaver's PENDING slot from the current round's
@@ -109,6 +262,19 @@ class FedMLAggregator:
         self.client_num = len(self._expected)
         return True
 
+    def quorum_target(self, frac: float) -> int:
+        """How many folds satisfy a quorum of ``frac`` over the CURRENT
+        round cohort. The denominator is ``client_num``, which
+        ``drop_expected`` shrinks when the failure detector declares a
+        rank dead mid-round — a corpse stops counting against the
+        quorum instead of stalling the grace timer."""
+        import math
+
+        return max(1, math.ceil(float(frac) * self.client_num))
+
+    def quorum_met(self, frac: float) -> bool:
+        return len(self._folded) >= self.quorum_target(frac)
+
     def begin_round(self, expected_indexes) -> None:
         """Declare which client indexes this round was broadcast to.
         With elastic membership the active set is not contiguous
@@ -117,34 +283,92 @@ class FedMLAggregator:
         self._expected = set(int(i) for i in expected_indexes)
         self.client_num = len(self._expected)
 
+    def _reconstructed_pending(self) -> List[Tuple[int, Params, float]]:
+        """Decode buffered payloads to full trees, sorted by index —
+        the full-cohort fallback's input."""
+        from ...core.compression import reconstruct_from_encoded
+
+        out = []
+        for i in sorted(self._pending):
+            kind, payload, w = self._pending[i]
+            if kind == "enc":
+                payload = reconstruct_from_encoded(
+                    self._codec, payload, self.global_params
+                )
+            out.append((i, payload, w))
+        return out
+
     def aggregate(self) -> Params:
-        """Weighted average of the received models
-        (fedml_aggregator.py:73-101). Aggregates whatever has been
-        received — under a deadline cohort (straggler handling) that
-        may be a subset; weights renormalize over the subset."""
-        idxs = sorted(self.model_dict.keys())
-        if not idxs:
+        """Close the aggregation window (fedml_aggregator.py:73-101
+        semantics). Aggregates whatever has been folded/buffered —
+        under a quorum/deadline cohort (straggler handling) that may be
+        a subset; weights renormalize over the subset, which the
+        streaming finalize does for free (it divides by the folded
+        total weight).
+
+        Streaming: the round's work already happened upload-by-upload;
+        this is an O(model) finalize. Buffered: the sorted buffer runs
+        through the SAME fold, so the two modes are bit-identical.
+        Full-cohort fallback (defense/custom aggregator): the legacy
+        stacked reduction."""
+        if not self._folded:
             raise RuntimeError("aggregate() with no received models")
-        trees = [self.model_dict[i] for i in idxs]
-        ns = jnp.asarray([self.sample_num_dict[i] for i in idxs])
-        stacked = stack_pytrees(trees)
-        weights = normalize_weights(ns)
-        if self.server_aggregator is not None:
-            # L3 operator seam (core/frame.py): custom pure reduction
-            rng = jax.random.fold_in(
-                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
-                self._agg_round,
-            )
-            self.global_params = self.server_aggregator.aggregate(
-                self.global_params, stacked, weights, rng
-            )
+        if self.streaming:
+            self.global_params = self._acc.finalize()
+        elif self._fallback_reason is not None:
+            idxs_trees = self._reconstructed_pending()
+            trees = [t for _, t, _ in idxs_trees]
+            ns = jnp.asarray([w for _, _, w in idxs_trees])
+            stacked = stack_pytrees(trees)
+            weights = normalize_weights(ns)
+            if self.server_aggregator is not None:
+                # L3 operator seam (core/frame.py): custom pure reduction
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
+                    self._agg_round,
+                )
+                self.global_params = self.server_aggregator.aggregate(
+                    self.global_params, stacked, weights, rng
+                )
+            else:
+                from ...core.aggregation import RobustAggregator
+
+                self.global_params = RobustAggregator(self.args).aggregate(
+                    stacked, weights, self.global_params,
+                    rng=jax.random.fold_in(
+                        jax.random.PRNGKey(
+                            int(getattr(self.args, "random_seed", 0))
+                        ),
+                        self._agg_round,
+                    ),
+                )
         else:
-            self.global_params = weighted_average(stacked, weights)
+            # buffered baseline: identical math to streaming, applied
+            # in sorted index order at close (order is immaterial — the
+            # fold is order-independent — but sorted keeps it obvious)
+            acc = StreamingAccumulator(self.global_params)
+            for i in sorted(self._pending):
+                kind, payload, w = self._pending[i]
+                if kind == "enc":
+                    acc.fold_encoded(self._codec, payload, self.global_params, w)
+                else:
+                    acc.fold(payload, w)
+                self.folds_total += 1
+                self._tel.inc("agg_folds_total", mode=self.agg_mode)
+            self.global_params = acc.finalize()
         self._agg_round += 1
-        self.model_dict.clear()
+        self._reset_window()
+        return self.global_params
+
+    def _reset_window(self) -> None:
+        """Clear per-round upload state (shared by ``aggregate`` and
+        the async publish path)."""
+        if self._acc is not None:
+            self._acc.reset()
+        self._pending.clear()
+        self._folded.clear()
         self.sample_num_dict.clear()
         self.flag_client_model_uploaded_dict.clear()
-        return self.global_params
 
     # -- selection (fedml_aggregator.py:103-153) ----------------------
     def data_silo_selection(

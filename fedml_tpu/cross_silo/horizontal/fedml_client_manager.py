@@ -71,9 +71,13 @@ class FedMLTrainer:
         i = self.client_index
         packed = self.dataset.packed_train
         client = Batches(x=packed.x[i], y=packed.y[i], mask=packed.mask[i])
+        # fold_in takes 32-bit data. Sync round indexes never come
+        # close (identical draws to the simulators), but async-mode
+        # dispatch seqs live in per-incarnation epoch bands above 2^32
+        # — reduce into range, deterministically
         rng = jax.random.fold_in(
             jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
-            round_idx * 100003 + i,
+            (round_idx * 100003 + i) % (2**31),
         )
         if self._round_lr is not None:
             mult = jnp.float32(
@@ -104,6 +108,10 @@ class FedMLClientManager(ClientManager):
 
         codec = make_codec(args)
         self._encoder = EncoderState(codec) if codec is not None else None
+        # async mode (agg_mode=async): uploads ship update DELTAS (the
+        # FedBuff currency — the server folds them into whatever the
+        # global model is by then), encoded when a codec is configured
+        self._async = str(getattr(args, "agg_mode", "stream")) == "async"
         from ...core.tracking import ProfilerEvent
 
         # spans mirror the reference's instrumentation points
@@ -236,7 +244,14 @@ class FedMLClientManager(ClientManager):
         # trace; this rides the upload so the server can emit
         # round_segment_seconds without waiting for a trace merge)
         out.add_params(constants.MSG_ARG_KEY_TRAIN_SECONDS, float(train_s))
-        if self._encoder is not None:
+        # async staleness bookkeeping: echo the publish version this
+        # model came from so the server can discount the update by how
+        # many publishes it missed (the server cross-checks against its
+        # own dispatch record; the echo keeps the wire self-describing)
+        base_version = msg.get(constants.MSG_ARG_KEY_MODEL_VERSION)
+        if base_version is not None:
+            out.add_params(constants.MSG_ARG_KEY_MODEL_VERSION, base_version)
+        if self._encoder is not None or self._async:
             # compressed uplink (core/compression.py): ship the encoded
             # update delta; the server reconstructs against the same
             # global tree it broadcast this round. A hierarchical silo
@@ -254,7 +269,10 @@ class FedMLClientManager(ClientManager):
             else:
                 delta = jax.tree.map(lambda a, b: a - b, new_params, params)
             out.add_params(
-                constants.MSG_ARG_KEY_MODEL_DELTA, self._encoder.encode(delta)
+                constants.MSG_ARG_KEY_MODEL_DELTA,
+                self._encoder.encode(delta)
+                if self._encoder is not None
+                else delta,  # async without a codec: raw delta
             )
         else:
             out.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, new_params)

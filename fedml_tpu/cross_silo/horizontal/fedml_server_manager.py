@@ -43,9 +43,38 @@ silent past the timeout is declared dead via a self-addressed
 on the dispatch thread — which folds into the same drop-expected path
 as an OFFLINE leave, so the round completes over the survivors.
 
+**Beyond the reference — streaming aggregate-on-arrival**: with
+``agg_mode: stream`` (default) every upload is folded into the
+aggregator's O(model) running accumulator the moment it lands
+(``core/aggregation.py``; quantized uplinks decode+accumulate in one
+fused jitted step), so the post-barrier "aggregate" is a finalize and
+server memory stops scaling with the cohort. On top of the fold,
+``round_quorum_frac`` + ``round_grace_s`` give a **quorum close**:
+once the quorum has folded, a grace timer arms (loopback message
+pattern, like the deadline); when it fires the round closes over the
+partial cohort with weights renormalized, and ranks the
+``FailureDetector`` declares dead leave the quorum denominator — a
+kill -9'd client shrinks the round instead of stalling the grace.
+Late uploads are discarded by round tag and counted
+(``agg_late_uploads_total``).
+
+**Beyond the reference — async staleness-weighted aggregation**
+(``agg_mode: async``, FedBuff-style): no round barrier exists at all.
+Each downlink carries a dispatch seq (in ``ROUND_INDEX``) and the
+publish ``MODEL_VERSION`` it shipped; clients upload update DELTAS
+which fold immediately with weight ``n * staleness_decay^staleness``
+(hard cap ``staleness_max``), and every ``async_publish_every`` folds
+the server publishes ``global += weighted-mean delta`` — through the
+checkpoint dir when one is set, so the PR-4 serving plane hot-swaps
+each publish. The WAL records the folded ``(rank, seq)`` set per
+publish; a restarted server seeds its dedup ledger from it, so a
+retransmitted pre-crash upload can neither double-fold nor be
+silently half-applied.
+
 **Beyond the reference — crash recovery**: with ``checkpoint_dir`` the
 server keeps a ``RoundWAL`` (round idx + checkpoint step + sampled
-cohort per completed round) next to its orbax checkpoints. A restarted
+cohort + folded set per completed round) next to its orbax
+checkpoints. A restarted
 server restores the newest checkpoint, cross-checks the WAL (loudly
 reporting rounds lost to ``checkpoint_freq > 1``), and releases
 reconnecting clients with ``MSG_TYPE_S2C_RESYNC`` — current round +
@@ -63,6 +92,13 @@ from typing import Dict
 from ... import constants
 from ...core.managers import ServerManager
 from ...core.message import Message
+
+# Async dispatch-seq epoch: each server incarnation issues seqs from
+# its own epoch band, so a seq handed out after the last durable
+# publish (and therefore unknown to the restored high-water mark) can
+# never be reissued by the next incarnation — the (rank, seq) fold
+# ledger stays collision-free without persisting every dispatch.
+_SEQ_EPOCH = 1 << 32
 
 
 def _resolve_client_real_ids(args, size: int):
@@ -144,6 +180,32 @@ class FedMLServerManager(ServerManager):
         self.deadline_s = float(getattr(args, "aggregation_deadline_s", 0) or 0)
         self._deadline_timer = None
         self.stragglers_dropped = 0
+        # streaming-aggregation round close (beyond the reference):
+        # quorum + grace; timers post loopback messages, never mutate
+        self.agg_mode = str(getattr(args, "agg_mode", "stream"))
+        self.quorum_frac = float(getattr(args, "round_quorum_frac", 0.0) or 0.0)
+        self.round_grace_s = float(getattr(args, "round_grace_s", 0.0) or 0.0)
+        self._quorum_timer = None
+        self._quorum_armed_round = None
+        self.quorum_closes = 0
+        # async (FedBuff-style) state — see the class docstring
+        self.staleness_decay = float(getattr(args, "staleness_decay", 0.5))
+        self.staleness_max = int(getattr(args, "staleness_max", 10))
+        self.async_publish_every = int(getattr(args, "async_publish_every", 4))
+        self.version = 0  # publish counter (the model version clients see)
+        self._dispatch_seq = 0  # monotone per-dispatch id, never reused
+        # folded pairs whose WAL record could not be written (disk
+        # error): carried into the next successful record so the
+        # ledger never under-covers the checkpointed params
+        self._unwaled_folds = []
+        # rank -> (seq, base_version, silo_idx) of its in-flight dispatch
+        self._outstanding: Dict[int, tuple] = {}
+        self._folded_ids = set()  # (rank, seq) ever folded (WAL-seeded)
+        self._folded_since_publish = []
+        self.async_folds = 0  # folds across incarnations (target counter)
+        # (rank, seq, staleness, sample_num, weight) — the bench checks
+        # these against the staleness_weight unit oracle
+        self.async_weight_log = []
         # zero-upload deadline handling: rebroadcast (the downlink may
         # have been lost) at most this many times per round, then shut
         # down instead of extending forever
@@ -215,20 +277,36 @@ class FedMLServerManager(ServerManager):
                     "cross-silo server resumed at round %d from %s",
                     self.round_idx, ckpt_dir,
                 )
-                # WAL cross-check: with checkpoint_freq > 1 the last
-                # COMPLETED round can be ahead of the newest restorable
-                # params — those rounds retrain after the restart; say
-                # so loudly instead of silently repeating work
-                last = self._wal.last()
-                if last is not None and int(last["round_idx"]) + 1 > self.round_idx:
-                    logging.warning(
-                        "round WAL shows round %d completed but newest "
-                        "checkpoint resumes at round %d — %d round(s) "
-                        "will retrain (checkpoint_freq=%d)",
-                        int(last["round_idx"]), self.round_idx,
-                        int(last["round_idx"]) + 1 - self.round_idx,
-                        self._ckpt_freq,
-                    )
+                if self.agg_mode == "async":
+                    # version/seq/fold counters ride the checkpoint;
+                    # the WAL's publish records are the exactly-once
+                    # fold ledger a restart must not forget (the
+                    # sync-mode retrain cross-check below does not
+                    # apply — async never retrains; lost publishes are
+                    # reported by _seed_async_ledger_from_wal instead)
+                    self.version = int(state.get("version", self.round_idx))
+                    self._dispatch_seq = int(state.get("dispatch_seq", 0))
+                    self.async_folds = int(state.get("async_folds", 0))
+                    self._seed_async_ledger_from_wal()
+                else:
+                    # WAL cross-check: with checkpoint_freq > 1 the
+                    # last COMPLETED round can be ahead of the newest
+                    # restorable params — those rounds retrain after
+                    # the restart; say so loudly instead of silently
+                    # repeating work
+                    last = self._wal.last()
+                    if (
+                        last is not None
+                        and int(last["round_idx"]) + 1 > self.round_idx
+                    ):
+                        logging.warning(
+                            "round WAL shows round %d completed but newest "
+                            "checkpoint resumes at round %d — %d round(s) "
+                            "will retrain (checkpoint_freq=%d)",
+                            int(last["round_idx"]), self.round_idx,
+                            int(last["round_idx"]) + 1 - self.round_idx,
+                            self._ckpt_freq,
+                        )
 
     # -- handlers ------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -243,6 +321,10 @@ class FedMLServerManager(ServerManager):
         self.register_message_receive_handler(
             constants.MSG_TYPE_S2S_AGG_DEADLINE,
             self.handle_message_deadline,
+        )
+        self.register_message_receive_handler(
+            constants.MSG_TYPE_S2S_QUORUM_GRACE,
+            self.handle_message_quorum_grace,
         )
         self.register_message_receive_handler(
             constants.MSG_TYPE_C2S_HEARTBEAT,
@@ -337,10 +419,16 @@ class FedMLServerManager(ServerManager):
             logging.info(
                 "elastic leave: rank %d offline at round %d", sender, self.round_idx
             )
+            if self.agg_mode == "async":
+                self._async_client_gone(sender)
+                return
             if self.is_initialized and self.aggregator.drop_expected(sender - 1):
                 # the round was only waiting on the leaver
                 if self.aggregator.check_whether_all_receive():
                     self._finish_round()
+                else:
+                    # the leaver also shrank the quorum denominator
+                    self._maybe_arm_quorum()
 
     # -- liveness / failure detection (beyond the reference) ----------
     def handle_message_heartbeat(self, msg: Message) -> None:
@@ -426,16 +514,52 @@ class FedMLServerManager(ServerManager):
             rank, self.round_idx,
             self._failure_detector.timeout_s if self._failure_detector else 0.0,
         )
+        if self.agg_mode == "async":
+            self._async_client_gone(rank)
+            return
         # same unstall path as an elastic OFFLINE leave — works with or
         # without elastic membership (a crash is not a voluntary leave)
         if self.is_initialized and self.aggregator.drop_expected(rank - 1):
             if self.aggregator.check_whether_all_receive():
                 self._finish_round()
+            else:
+                # quorum accounting consults the failure detector: a
+                # dead rank leaves the denominator, so a quorum that
+                # was one corpse short arms its grace timer now
+                self._maybe_arm_quorum()
+
+    def _async_client_gone(self, rank: int) -> None:
+        """A dead/left rank in async mode: retire its in-flight
+        dispatch (a reconnect gets fresh work via RESYNC), and if
+        NOBODY is left to fold from, shut down loudly — async's only
+        finish path is an upload, so an empty federation would
+        otherwise hang forever (the sync path's empty-broadcast
+        shutdown has no async equivalent)."""
+        self._outstanding.pop(rank, None)
+        if self.is_initialized and not self._active_ranks():
+            logging.error(
+                "async: no online clients remain (%d/%d folds done); "
+                "finishing", self.async_folds, self._async_target_folds(),
+            )
+            # accepted-but-unpublished folds must reach the model and
+            # the WAL ledger before the shutdown (the fold-target
+            # finish path flushes the same way)
+            self._async_publish()
+            self.send_finish()
+            self.finish()
 
     def _maybe_resync(self, rank: int) -> None:
         """Ship the CURRENT round + params + pending assignment to a
         rank that (re)appeared mid-round — a restarted client resumes
         the round instead of stalling it until detector/deadline."""
+        if self.agg_mode == "async":
+            # async reconnect: hand the rank fresh work at the current
+            # version (a fresh seq supersedes any pre-crash dispatch,
+            # so its in-flight upload — if any — discards cleanly)
+            logging.info("RESYNC (async): dispatching rank %d fresh work", rank)
+            self.telemetry.inc("cross_silo_resyncs_total")
+            self._async_dispatch(rank, constants.MSG_TYPE_S2C_RESYNC)
+            return
         silo_idx = self._round_assignment.get(rank)
         if silo_idx is None:
             return  # not part of the current round; next broadcast picks it up
@@ -457,6 +581,23 @@ class FedMLServerManager(ServerManager):
 
     def send_init_msg(self) -> None:
         """(fedml_server_manager.py:47-69)"""
+        if self.agg_mode == "async":
+            if self.async_folds >= self._async_target_folds():
+                # resumed past the fold target: release clients cleanly
+                logging.info(
+                    "async resume: %d folds already done (target %d); "
+                    "finishing", self.async_folds, self._async_target_folds(),
+                )
+                self.aggregator.test_on_server_for_all_clients(self.version)
+                self.send_finish()
+                self.finish()
+                return
+            self._async_begin(
+                constants.MSG_TYPE_S2C_RESYNC
+                if self._resumed
+                else constants.MSG_TYPE_S2C_INIT_CONFIG
+            )
+            return
         if self.round_idx >= self.round_num:
             # resumed from a checkpoint taken at/after the final round:
             # nothing left to train, release the freshly-connected
@@ -627,15 +768,73 @@ class FedMLServerManager(ServerManager):
         )
         self._finish_round()
 
+    def _extract_upload_payload(self, msg: Message, sender_rank: int):
+        """Validate an upload's payload against the server codec and
+        return ``(model_params, encoded)`` (exactly one set), or None
+        after shutting the federation down on a fatal config mismatch.
+        Neither is decoded here — the streaming fold decodes inside its
+        fused jitted step; the buffered path decodes at aggregate."""
+        model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
+        if model_params is not None:
+            if self._codec is not None:
+                logging.warning(
+                    "server has compression=%s but rank %d uploaded full "
+                    "model_params; aggregating it, but the uplink is NOT "
+                    "compressed — check the client config",
+                    self.args.compression,
+                    sender_rank,
+                )
+            return model_params, None
+        encoded = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
+        if encoded is None:
+            mismatch = "carries neither model_params nor model_delta"
+        elif self._codec is None:
+            mismatch = "is compressed but server has compression=none"
+        else:
+            mismatch = self._codec_mismatch(encoded)
+        if mismatch:
+            self._fatal_payload_mismatch(sender_rank, mismatch)
+            return None
+        return None, encoded
+
+    def _codec_mismatch(self, encoded) -> "str | None":
+        """Does this wire payload fit the server codec? (shared by the
+        sync and async upload paths)."""
+        from ...core.compression import payload_matches_codec
+
+        if not payload_matches_codec(self._codec, encoded):
+            return (
+                f"payload does not match server codec "
+                f"'{self._codec.name}' (int8 vs topk skew)"
+            )
+        return None
+
+    def _fatal_payload_mismatch(self, sender_rank: int, mismatch: str) -> None:
+        """Config mismatch is fatal but must not strand clients: shut
+        the federation down cleanly (same pattern as the
+        no-online-clients path in _broadcast_model)."""
+        logging.error(
+            "rank %d upload %s; configure args.compression (and agg_mode) "
+            "identically on server and clients — finishing run",
+            sender_rank,
+            mismatch,
+        )
+        self.send_finish()
+        self.finish()
+
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         """(fedml_server_manager.py:121-207)"""
         sender_rank = int(msg.get_sender_id())
+        if self.agg_mode == "async":
+            self._handle_async_upload(msg, sender_rank)
+            return
         upload_round = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, self.round_idx))
         if upload_round != self.round_idx:
             logging.warning(
                 "discarding straggler upload from rank %d for round %d "
                 "(now on round %d)", sender_rank, upload_round, self.round_idx,
             )
+            self.telemetry.inc("agg_late_uploads_total")
             return
         import time as _time
 
@@ -649,68 +848,373 @@ class FedMLServerManager(ServerManager):
             reported_train_s = msg.get(constants.MSG_ARG_KEY_TRAIN_SECONDS)
             if reported_train_s is not None:
                 self._upload_train_s[sender_rank] = float(reported_train_s)
-        model_params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
-        if model_params is None:
-            encoded = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
-            from ...core.compression import decode_delta, payload_matches_codec
-
-            if encoded is None:
-                mismatch = "carries neither model_params nor model_delta"
-            elif self._codec is None:
-                mismatch = "is compressed but server has compression=none"
-            elif not payload_matches_codec(self._codec, encoded):
-                mismatch = (
-                    f"payload does not match server codec "
-                    f"'{self._codec.name}' (int8 vs topk skew)"
-                )
-            else:
-                mismatch = None
-            if mismatch:
-                # config mismatch is fatal but must not strand clients:
-                # shut the federation down cleanly (same pattern as the
-                # no-online-clients path in _broadcast_model)
-                logging.error(
-                    "rank %d upload %s; configure args.compression "
-                    "identically on server and clients — finishing run",
-                    sender_rank,
-                    mismatch,
-                )
-                self.send_finish()
-                self.finish()
-                return
-            import jax
-
-            from ...core.aggregation import reconcile_to_device
-
-            g = self.aggregator.get_global_model_params()
-            # a hierarchical silo's payload lives on ITS device subset;
-            # reconcile onto the server's device before decoding
-            encoded = reconcile_to_device(encoded)
-            delta = decode_delta(self._codec, encoded, g)
-            model_params = jax.tree.map(lambda a, b: a + b, g, delta)
-        elif self._codec is not None:
-            logging.warning(
-                "server has compression=%s but rank %d uploaded full "
-                "model_params; aggregating it, but the uplink is NOT "
-                "compressed — check the client config",
-                self.args.compression,
-                sender_rank,
-            )
+        payload = self._extract_upload_payload(msg, sender_rank)
+        if payload is None:
+            return
+        model_params, encoded = payload
         local_sample_num = msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(
-            sender_rank - 1, model_params, local_sample_num
+        # streaming (agg_mode=stream): folded into the running
+        # accumulator RIGHT NOW — the straggler-wait window does the
+        # aggregation work, and quantized payloads decode inside the
+        # fold's fused jit. Buffered/fallback: stored until close.
+        self.aggregator.receive_upload(
+            sender_rank - 1,
+            local_sample_num,
+            model_params=model_params,
+            encoded=encoded,
         )
         if not self._wait_open:
             self.profiler.log_event_started("server.wait")
             self._wait_open = True
-        if not self.aggregator.check_whether_all_receive():
+        if self.aggregator.check_whether_all_receive():
+            self._finish_round()
             return
+        self._maybe_arm_quorum()
+
+    # -- quorum round close (streaming tentpole) ----------------------
+    def _maybe_arm_quorum(self) -> None:
+        """Arm the grace timer the first time the current round's
+        folded count reaches quorum. The denominator is the LIVE
+        cohort: ``drop_expected`` (elastic leaves, failure-detector
+        deaths) shrinks it, so this is re-checked from those paths too
+        — a declared-dead rank can tip an already-arrived quorum into
+        arming instead of waiting on a corpse."""
+        if (
+            self.quorum_frac <= 0
+            or not self.is_initialized
+            or self._quorum_armed_round == self.round_idx
+            or not self.aggregator.quorum_met(self.quorum_frac)
+        ):
+            return
+        import threading
+
+        self._quorum_armed_round = self.round_idx
+        round_idx = self.round_idx
+        n = self.aggregator.num_received()
+        logging.info(
+            "round %d: quorum reached (%d/%d folded >= target %d); "
+            "grace %.2fs for the rest",
+            round_idx, n, self.aggregator.client_num,
+            self.aggregator.quorum_target(self.quorum_frac),
+            self.round_grace_s,
+        )
+
+        def fire() -> None:
+            msg = Message(
+                constants.MSG_TYPE_S2S_QUORUM_GRACE, self.rank, self.rank
+            )
+            msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, round_idx)
+            self._post_loopback(
+                msg, "quorum grace message",
+                stale=lambda: round_idx != self.round_idx,
+            )
+
+        self._quorum_timer = threading.Timer(self.round_grace_s, fire)
+        self._quorum_timer.daemon = True
+        self._quorum_timer.start()
+
+    def _cancel_quorum(self) -> None:
+        if self._quorum_timer is not None:
+            self._quorum_timer.cancel()
+            self._quorum_timer = None
+        self._quorum_armed_round = None
+
+    def handle_message_quorum_grace(self, msg: Message) -> None:
+        fired_round = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, -1))
+        if fired_round != self.round_idx:
+            return  # the round completed in time; stale timer
+        n = self.aggregator.num_received()
+        expected = self.aggregator.client_num
+        missing = max(expected - n, 0)
+        if n == 0:
+            return  # cannot happen (armed only after a fold) — guard anyway
+        if missing:
+            ages = {}
+            for idx in self.aggregator.missing_indexes():
+                rank = idx + 1
+                age = (
+                    self._failure_detector.last_seen_age_s(rank)
+                    if self._failure_detector is not None
+                    else None
+                )
+                ages[rank] = None if age is None else round(age, 2)
+            self.stragglers_dropped += missing
+            self.quorum_closes += 1
+            self.telemetry.inc("agg_quorum_closes_total")
+            logging.warning(
+                "round %d quorum close: aggregating %d/%d clients after "
+                "%.2fs grace (%d straggler(s) dropped; last seen ages %s)",
+                self.round_idx, n, expected, self.round_grace_s, missing, ages,
+            )
         self._finish_round()
+
+    # -- async (FedBuff-style) aggregation (agg_mode=async) -----------
+    def _async_target_folds(self) -> int:
+        """Run length in folds: the async analog of comm_round — the
+        federation finishes once comm_round x client_num_per_round
+        updates have been accepted (discarded-stale ones don't count)."""
+        return int(self.args.comm_round) * int(self.args.client_num_per_round)
+
+    def _seed_async_ledger_from_wal(self) -> None:
+        """Rebuild the exactly-once fold ledger after a restart: every
+        WAL publish record's ``folded`` (rank, seq) pairs are already
+        inside (or superseded with) the restored params, so a
+        retransmitted pre-crash upload must never fold again. The WAL
+        is written BEFORE the checkpoint (write-ahead), so the ledger
+        can only over-cover — an upload may be dropped after a badly
+        timed crash (its sender gets fresh work), but never folded
+        twice. Publishes that made the WAL but not the checkpoint
+        (every publish checkpoints, so that window is one publish) are
+        reported LOUDLY: their folds' contributions are gone from the
+        params and are not replayable."""
+        ckpt_version = self.version  # what the restored params contain
+        publishes = 0
+        lost_folds = []
+        for rec in self._wal.records():
+            if rec.get("kind") != "publish":
+                continue
+            publishes += 1
+            rec_version = int(rec.get("version", 0))
+            for pair in rec.get("folded") or []:
+                if isinstance(pair, (list, tuple)) and len(pair) == 2:
+                    self._folded_ids.add((int(pair[0]), int(pair[1])))
+                    if rec_version > ckpt_version:
+                        lost_folds.append((int(pair[0]), int(pair[1])))
+            self._dispatch_seq = max(
+                self._dispatch_seq, int(rec.get("max_seq", 0))
+            )
+            self.async_folds = max(
+                self.async_folds, int(rec.get("folds_total", 0))
+            )
+            self.version = max(self.version, rec_version)
+        self.round_idx = self.version
+        # new incarnation = new seq epoch: dispatches issued between
+        # the last durable publish and the crash carried seqs above the
+        # restored high-water mark; stepping to the next epoch band
+        # guarantees none of them is ever reissued
+        self._dispatch_seq = (self._dispatch_seq // _SEQ_EPOCH + 1) * _SEQ_EPOCH
+        if lost_folds:
+            logging.warning(
+                "async resume: %d fold(s) %s from publish(es) > version %d "
+                "were write-ahead logged but their checkpoint never landed "
+                "— those contributions are LOST (not replayable; their "
+                "senders get fresh work). They stay in the dedup ledger so "
+                "retransmits cannot half-apply them.",
+                len(lost_folds), sorted(lost_folds), ckpt_version,
+            )
+        if publishes:
+            logging.info(
+                "async resume: %d publish record(s) seed a %d-entry fold "
+                "ledger; version %d, %d/%d folds done, dispatch seq > %d",
+                publishes, len(self._folded_ids), self.version,
+                self.async_folds, self._async_target_folds(),
+                self._dispatch_seq,
+            )
+
+    def _async_begin(self, msg_type: str) -> None:
+        """Initial (or post-restart) dispatch: every online rank gets
+        the current model + a fresh seq. No barrier ever forms — each
+        upload triggers that rank's next dispatch."""
+        ranks = self._active_ranks()
+        if not ranks:
+            logging.error("async: no online clients to dispatch; finishing")
+            self.send_finish()
+            self.finish()
+            return
+        silos = self.aggregator.data_silo_selection(
+            0, int(self.args.client_num_in_total), len(ranks)
+        )
+        for r, s in zip(ranks, silos):
+            self._round_assignment.setdefault(r, s)
+        logging.info(
+            "async federation: dispatching %d clients (target %d folds, "
+            "publish every %d, staleness decay %.3g cap %d)",
+            len(ranks), self._async_target_folds(), self.async_publish_every,
+            self.staleness_decay, self.staleness_max,
+        )
+        for r in ranks:
+            self._async_dispatch(r, msg_type)
+
+    def _async_dispatch(
+        self,
+        rank: int,
+        msg_type: str = constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+    ) -> None:
+        if not self.client_online_status.get(rank, False):
+            return  # nothing to hand a rank that is not there
+        self._dispatch_seq += 1
+        seq = self._dispatch_seq
+        silo = self._round_assignment.get(
+            rank, (rank - 1) % max(int(self.args.client_num_in_total), 1)
+        )
+        self._round_assignment[rank] = silo
+        # one outstanding dispatch per rank; overwriting supersedes any
+        # in-flight predecessor (its upload will fail the seq check)
+        self._outstanding[rank] = (seq, self.version, silo)
+        msg = Message(msg_type, self.rank, rank)
+        msg.add_params(
+            constants.MSG_ARG_KEY_MODEL_PARAMS,
+            self.aggregator.get_global_model_params(),
+        )
+        msg.add_params(constants.MSG_ARG_KEY_CLIENT_INDEX, silo)
+        msg.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, seq)
+        msg.add_params(constants.MSG_ARG_KEY_MODEL_VERSION, self.version)
+        self.send_message(msg)
+
+    def _handle_async_upload(self, msg: Message, sender_rank: int) -> None:
+        seq = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, -1))
+        if (sender_rank, seq) in self._folded_ids:
+            # retransmit of an upload that already folded (possibly
+            # before a server restart — the WAL ledger remembers)
+            self.telemetry.inc("agg_async_superseded_total", reason="dup")
+            return
+        out = self._outstanding.get(sender_rank)
+        if out is None or out[0] != seq:
+            # not this rank's in-flight dispatch: a duplicate raced its
+            # redispatch, or a pre-crash upload whose work was reissued
+            self.telemetry.inc("agg_async_superseded_total", reason="superseded")
+            logging.info(
+                "async: discarding superseded upload from rank %d (seq %d)",
+                sender_rank, seq,
+            )
+            return
+        _seq, base_version, _silo = out
+        payload = msg.get(constants.MSG_ARG_KEY_MODEL_DELTA)
+        if payload is None:
+            self._fatal_payload_mismatch(
+                sender_rank,
+                "carries no model_delta (async clients ship update "
+                "deltas; set agg_mode=async on every process)",
+            )
+            return
+        raw, enc = (payload, None) if self._codec is None else (None, payload)
+        if enc is not None:
+            mismatch = self._codec_mismatch(enc)
+            if mismatch:
+                self._fatal_payload_mismatch(sender_rank, mismatch)
+                return
+        del self._outstanding[sender_rank]
+        staleness = max(self.version - int(base_version), 0)
+        n = float(msg.get(constants.MSG_ARG_KEY_NUM_SAMPLES))
+        if staleness > self.staleness_max:
+            self.telemetry.inc("agg_stale_discarded_total")
+            logging.warning(
+                "async: rank %d update is %d publishes stale "
+                "(> staleness_max=%d); discarded",
+                sender_rank, staleness, self.staleness_max,
+            )
+        else:
+            scale = float(self.staleness_decay) ** staleness
+            self.aggregator.fold_delta(
+                n, delta=raw, encoded=enc, weight_scale=scale
+            )
+            self._folded_ids.add((sender_rank, seq))
+            self._folded_since_publish.append((sender_rank, seq))
+            self.async_folds += 1
+            self.async_weight_log.append(
+                {
+                    "rank": sender_rank,
+                    "seq": seq,
+                    "staleness": staleness,
+                    "sample_num": n,
+                    "weight": n * scale,
+                }
+            )
+            self.telemetry.observe(
+                "agg_staleness", staleness, buckets=(0, 1, 2, 4, 8, 16)
+            )
+            if len(self._folded_since_publish) >= self.async_publish_every:
+                self._async_publish()
+        if self.async_folds >= self._async_target_folds():
+            self._async_publish()  # flush the partial buffer
+            logging.info(
+                "async federation done: %d folds, %d publishes",
+                self.async_folds, self.version,
+            )
+            self.aggregator.test_on_server_for_all_clients(self.version)
+            self.send_finish()
+            self.finish()
+            return
+        self._async_dispatch(sender_rank)
+
+    def _async_publish(self) -> None:
+        """Fold buffer -> global model -> durable publish. WAL first
+        (write-ahead: the fold ledger must cover everything the params
+        might contain), then the checkpoint — which is also the serving
+        plane's hot-swap feed (``CheckpointWatcher`` polls the same
+        dir, so every publish can go live without a restart)."""
+        folded = self._folded_since_publish
+        if not folded:
+            return
+        with self.profiler.span("async_publish", version=self.version + 1):
+            self.aggregator.publish_async()
+        self.version += 1
+        self.round_idx = self.version
+        self._folded_since_publish = []
+        # EVERY publish checkpoints (checkpoint_freq does not apply in
+        # async): the publish cadence IS the durability cadence — folds
+        # applied to an uncheckpointed publish are unreplayable, so a
+        # sparser checkpoint would turn every crash into silent update
+        # loss. Tune async_publish_every to trade checkpoint I/O for
+        # freshness instead.
+        ckpt_due = self._ckpt is not None
+        if self._wal is not None:
+            try:
+                self._wal.append(
+                    self.version,
+                    self.version if ckpt_due else None,
+                    sorted(self._outstanding),
+                    # include any folds orphaned by an earlier failed
+                    # append: the ledger must cover everything the
+                    # about-to-be-checkpointed params contain
+                    folded=self._unwaled_folds + folded,
+                    kind="publish",
+                    extra={
+                        "version": self.version,
+                        "max_seq": self._dispatch_seq,
+                        "folds_total": self.async_folds,
+                    },
+                )
+                self._unwaled_folds = []
+            except OSError:
+                # write-ahead invariant: the ledger must cover every
+                # fold a checkpoint might contain. If the WAL cannot be
+                # written, SKIP this publish's checkpoint too — a
+                # checkpoint whose folds are missing from the ledger
+                # would let a retransmit double-fold after a restart.
+                # The params stay live in memory; the next successful
+                # publish carries them.
+                logging.exception(
+                    "async WAL append failed for publish %d; skipping its "
+                    "checkpoint (durability degraded until the WAL "
+                    "recovers)", self.version,
+                )
+                self._unwaled_folds.extend(folded)
+                ckpt_due = False
+        if ckpt_due:
+            self._save_checkpoint()
+        self.telemetry.inc("agg_publish_total")
+        self.telemetry.heartbeat("cross_silo.round", self.version)
+        self.telemetry.inc("cross_silo_rounds_total")
+        self.metrics_reporter.report(
+            {
+                "kind": "async_publish",
+                "version": self.version,
+                "folds": len(folded),
+                "folds_total": self.async_folds,
+            }
+        )
+        logging.info(
+            "async publish %d: %d fold(s) applied (%d/%d total)",
+            self.version, len(folded), self.async_folds,
+            self._async_target_folds(),
+        )
 
     def _finish_round(self) -> None:
         """Aggregate whatever was received, eval, advance (shared by
-        the all-received and deadline paths)."""
+        the all-received, deadline and quorum-grace paths)."""
         self._cancel_deadline()
+        self._cancel_quorum()
         self._empty_deadline_fires = 0
         if self._wait_open:
             self.profiler.log_event_ended("server.wait")
@@ -718,6 +1222,9 @@ class FedMLServerManager(ServerManager):
         import time as _time
 
         n_aggregated = self.aggregator.num_received()
+        # which ranks actually folded into this aggregate (the WAL's
+        # exactly-once record) — captured BEFORE aggregate() resets it
+        folded_ranks = [i + 1 for i in self.aggregator.folded_indexes()]
         t_agg0 = _time.perf_counter()
         if n_aggregated:
             # the round tag lets the critical-path analyzer pick THIS
@@ -751,7 +1258,7 @@ class FedMLServerManager(ServerManager):
         if self.round_idx >= self.round_num:
             if ckpt_due:
                 self._save_checkpoint()
-            self._wal_append(eval_round, ckpt_due, cohort_ranks)
+            self._wal_append(eval_round, ckpt_due, cohort_ranks, folded_ranks)
             if n_aggregated:
                 self.aggregator.test_on_server_for_all_clients(eval_round)
             self._report_round(eval_round, cohort, n_aggregated)
@@ -768,7 +1275,7 @@ class FedMLServerManager(ServerManager):
         self._broadcast_model(constants.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
         if ckpt_due:
             self._save_checkpoint()
-        self._wal_append(eval_round, ckpt_due, cohort_ranks)
+        self._wal_append(eval_round, ckpt_due, cohort_ranks, folded_ranks)
         if n_aggregated:
             with self.profiler.span("server_eval_overlapped"):
                 self.aggregator.test_on_server_for_all_clients(eval_round)
@@ -831,21 +1338,30 @@ class FedMLServerManager(ServerManager):
             )
 
     def _save_checkpoint(self) -> None:
-        """step = the NEXT round to run; a restarted server picks up
-        exactly where the broadcast would have gone."""
-        self._ckpt.save(
-            self.round_idx,
-            {
-                "params": self.aggregator.get_global_model_params(),
-                "round_idx": self.round_idx,
-                "agg_round": self.aggregator._agg_round,
-            },
-        )
+        """step = the NEXT round to run (sync) or the publish version
+        (async); a restarted server picks up exactly where the
+        broadcast/dispatch would have gone."""
+        state = {
+            "params": self.aggregator.get_global_model_params(),
+            "round_idx": self.round_idx,
+            "agg_round": self.aggregator._agg_round,
+        }
+        if self.agg_mode == "async":
+            state.update(
+                version=self.version,
+                dispatch_seq=self._dispatch_seq,
+                async_folds=self.async_folds,
+            )
+        self._ckpt.save(self.round_idx, state)
 
-    def _wal_append(self, eval_round: int, ckpt_saved: bool, cohort_ranks) -> None:
+    def _wal_append(
+        self, eval_round: int, ckpt_saved: bool, cohort_ranks, folded_ranks=None
+    ) -> None:
         """One WAL record per COMPLETED round (crash recovery): which
         round finished, which checkpoint step (if any) carries it, who
-        the round was broadcast to."""
+        the round was broadcast to, and whose uploads actually folded
+        into the aggregate (a strict subset under a quorum/deadline
+        close — the exactly-once ledger)."""
         if self._wal is None:
             return
         try:
@@ -853,6 +1369,7 @@ class FedMLServerManager(ServerManager):
                 eval_round,
                 self.round_idx if ckpt_saved else None,
                 cohort_ranks,
+                folded=folded_ranks,
             )
         except OSError:
             # the WAL is an aid to recovery, never a reason to kill a

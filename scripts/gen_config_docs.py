@@ -175,6 +175,8 @@ GROUPS = [
         "warmup_rounds",
     ]),
     ("Cross-silo robustness & comms", [
+        "agg_mode", "round_quorum_frac", "round_grace_s",
+        "staleness_decay", "staleness_max", "async_publish_every",
         "aggregation_deadline_s", "aggregation_deadline_max_extensions",
         "compression", "compression_topk_ratio", "elastic_membership",
         "grpc_ipconfig_path", "grpc_port_base", "fault_injection",

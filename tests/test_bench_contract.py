@@ -94,6 +94,21 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"chaos"' in parent or "'chaos'" in parent
 
+    def test_straggler_phase_contract(self):
+        """detail.straggler ships the streaming-aggregation evidence
+        (sync-streaming bit-identical to the buffered baseline at
+        O(model) server memory, quorum rounds tracking quorum arrival
+        instead of a 10x straggler, async exactly-once folds with
+        oracle-checked staleness weights under faults + kill +
+        restart): the phase is in the child vocabulary and the parent
+        stitches it (like chaos, it runs demoted on the CPU
+        fallback)."""
+        assert "straggler" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"straggler"' in parent or "'straggler'" in parent
+
     def test_tracing_phase_contract(self):
         """detail.tracing ships the distributed-tracing evidence
         (matched cross-process flows, critical-path segment sums,
@@ -217,6 +232,43 @@ class TestPhaseChild:
         assert d["exactly_once"] is True
         assert d["max_abs_diff_vs_clean"] == 0.0
         assert d["params_match_clean"] is True
+
+    @pytest.mark.slow  # ~2min bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's straggler smoke block
+    def test_straggler_smoke_child_writes_valid_json(self):
+        """The CI straggler smoke invocation (4 clients x 3 rounds,
+        CPU): the streaming-aggregation tentpole runs end-to-end
+        through bench.py's straggler phase child — buffered baseline,
+        bit-identical sync streaming, quorum close past a delayed + a
+        killed client, async exactly-once under faults + restart —
+        and emits the detail.straggler contract keys."""
+        d = self._run_child("straggler", 500, smoke=True)
+        # sync streaming: bit-identity at O(model) memory
+        assert d["stream_identical_to_buffered"] is True
+        assert d["max_abs_diff_stream_vs_buffered"] == 0.0
+        assert d["stream_peak_buffered"] == 0
+        assert d["buffered_peak_buffered"] == d["clients"]
+        # quorum: rounds complete on quorum arrival, not the straggler
+        q = d["quorum"]
+        assert q["rounds_completed"] == d["rounds"]
+        assert q["quorum_closes"] >= 1
+        assert q["deaths"] == 1  # the kill -9'd client was declared
+        assert q["stragglers_dropped"] >= 1
+        assert q["tracks_quorum_not_straggler"] is True
+        assert q["wall_s"] < q["blocked_wall_bound_s"]
+        assert q["peak_buffered"] == 0
+        # async: exactly-once folds + staleness oracle across a restart
+        a = d["async"]
+        assert a["server_restarted"] is True
+        assert a["client_killed"] is True
+        assert a["folds_total"] >= a["target_folds"]
+        assert a["publishes"] >= 2
+        assert a["double_folds"] == 0
+        assert a["refolded_across_restart"] == 0
+        assert a["folds_counter_total"] == a["wal_folded_pairs"]
+        assert a["exactly_once"] is True
+        assert a["stale_folds"] >= 1
+        assert a["staleness_weights_match_oracle"] is True
 
     @pytest.mark.slow  # ~90s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's tracing smoke block

@@ -296,6 +296,27 @@ class TestRoundWAL:
         wal = RoundWAL(str(tmp_path))
         assert wal.records() == [] and wal.last() is None
 
+    def test_folded_set_and_publish_records(self, tmp_path):
+        """The exactly-once ledger: sync rounds record the folded rank
+        set (a subset of the cohort under a quorum close); async
+        publishes record (rank, seq) pairs + the dispatch high-water
+        mark — and a fresh WAL instance (the restarted server) reads
+        them all back."""
+        from fedml_tpu.core.checkpoint import RoundWAL
+
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1, 2, 3], folded=[2, 1])
+        wal.append(
+            1, None, [1, 2], folded=[(1, 5), (2, 7)], kind="publish",
+            extra={"version": 1, "max_seq": 7, "folds_total": 2},
+        )
+        recs = RoundWAL(str(tmp_path)).records()
+        assert recs[0]["folded"] == [1, 2]
+        assert "kind" not in recs[0]
+        assert recs[1]["kind"] == "publish"
+        assert recs[1]["folded"] == [[1, 5], [2, 7]]
+        assert recs[1]["max_seq"] == 7 and recs[1]["folds_total"] == 2
+
 
 class TestGrpcSendRetry:
     def test_exhausted_retries_raise_typed_error_and_count(self):
@@ -395,6 +416,378 @@ class TestDownloadRetry:
         )
         assert ok is False
         assert len(calls) == 1  # not retried
+
+
+# ---------------------------------------------------------------------
+# streaming aggregate-on-arrival (docs/robustness.md round-barrier
+# failure model): the fold's exactness/fallback contracts in isolation
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+class TestStreamingAccumulatorUnit:
+    def _trees(self, n=6, seed=0):
+        rng = np.random.RandomState(seed)
+        trees, ws = [], []
+        for _ in range(n):
+            scale = 10.0 ** rng.randint(-6, 5)
+            trees.append(
+                {
+                    "k": jax.numpy.asarray(
+                        rng.randn(33, 9).astype(np.float32) * scale
+                    ),
+                    "b": jax.numpy.asarray(rng.randn(9).astype(np.float32)),
+                }
+            )
+            ws.append(float(rng.randint(1, 400)))
+        return trees, ws
+
+    def test_fold_is_bitwise_order_independent(self):
+        """The acceptance property the straggler bench leans on:
+        whatever order uploads arrive in, finalize() produces the SAME
+        float32 bits — even with adversarial magnitude spreads."""
+        from fedml_tpu.core.aggregation import StreamingAccumulator
+
+        trees, ws = self._trees()
+        rng = np.random.RandomState(7)
+
+        def run(order):
+            acc = StreamingAccumulator(trees[0])
+            for i in order:
+                acc.fold(trees[i], ws[i])
+            return acc.finalize()
+
+        ref = run(range(len(trees)))
+        for _ in range(10):
+            out = run(rng.permutation(len(trees)).tolist())
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                ),
+                ref, out,
+            )
+
+    def test_fold_matches_weighted_mean(self):
+        from fedml_tpu.core.aggregation import StreamingAccumulator
+
+        trees, ws = self._trees(n=4, seed=3)
+        acc = StreamingAccumulator(trees[0])
+        for t, w in zip(trees, ws):
+            acc.fold(t, w)
+        W = sum(ws)
+        want = jax.tree.map(
+            lambda *xs: sum(
+                w * np.asarray(x, np.float64) for w, x in zip(ws, xs)
+            ) / W,
+            *trees,
+        )
+        jax.tree.map(
+            lambda got, w: np.testing.assert_allclose(
+                np.asarray(got), w, rtol=5e-6, atol=1e-7
+            ),
+            acc.finalize(), want,
+        )
+
+    def test_partial_cohort_renormalizes(self):
+        """A quorum-closed round folds a subset; the finalize divides
+        by the folded weight only — identical to a federation that
+        never had the stragglers."""
+        from fedml_tpu.core.aggregation import StreamingAccumulator
+
+        trees, ws = self._trees(n=5, seed=5)
+        full = StreamingAccumulator(trees[0])
+        sub = StreamingAccumulator(trees[0])
+        for i in (0, 2):
+            full.fold(trees[i], ws[i])
+            sub.fold(trees[i], ws[i])
+        # the subset accumulator is DONE; full would have folded more
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            full.finalize(), sub.finalize(),
+        )
+
+    def test_fused_encoded_fold_is_order_independent(self):
+        from fedml_tpu.core.aggregation import StreamingAccumulator
+        from fedml_tpu.core.compression import Int8Codec
+
+        codec = Int8Codec()
+        trees, ws = self._trees(n=3, seed=9)
+        g = trees[0]
+        encs = [
+            codec.encode(jax.tree.map(lambda x: x * 0.01, t)) for t in trees
+        ]
+        a1 = StreamingAccumulator(g)
+        a2 = StreamingAccumulator(g)
+        for i in (0, 1, 2):
+            a1.fold_encoded(codec, encs[i], g, ws[i])
+        for i in (2, 0, 1):
+            a2.fold_encoded(codec, encs[i], g, ws[i])
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            a1.finalize(), a2.finalize(),
+        )
+
+    def test_finalize_empty_raises(self):
+        from fedml_tpu.core.aggregation import StreamingAccumulator
+
+        acc = StreamingAccumulator({"a": jax.numpy.zeros(3)})
+        with pytest.raises(RuntimeError, match="no folded"):
+            acc.finalize()
+
+
+@pytest.mark.smoke
+class TestStreamingFallback:
+    def test_full_cohort_reasons(self, args_factory):
+        from fedml_tpu.core.aggregation import needs_full_cohort
+        from fedml_tpu.core.frame import DefaultServerAggregator
+
+        a = args_factory()
+        assert needs_full_cohort(a, None) is None
+        a.defense_type = "median"
+        assert "median" in needs_full_cohort(a, None)
+        a.defense_type = None
+        assert "ServerAggregator" in needs_full_cohort(
+            a, DefaultServerAggregator(None)
+        )
+
+    def test_stream_mode_falls_back_loudly(self, args_factory, caplog):
+        """agg_mode=stream + median defense: ONE warning, the counter,
+        and the buffered path — never a silent wrong answer."""
+        import logging as _logging
+
+        import fedml_tpu
+        from fedml_tpu import models
+        from fedml_tpu.cross_silo.horizontal.fedml_aggregator import (
+            FedMLAggregator,
+        )
+        from fedml_tpu.data import load
+
+        Telemetry.reset()
+        a = _mk_args(
+            args_factory, "fb1", "LOCAL", agg_mode="stream",
+            defense_type="median",
+        )
+        a.rank = 0
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        with caplog.at_level(_logging.WARNING):
+            agg = FedMLAggregator(a, m)
+        assert not agg.streaming
+        warns = [
+            r for r in caplog.records
+            if "falling back to the BUFFERED" in r.getMessage()
+        ]
+        assert len(warns) == 1
+        tel = Telemetry.get_instance()
+        assert sum(
+            tel.counters_matching("agg_stream_fallback_total").values()
+        ) == 1
+        # the buffered fallback applies the median over the cohort
+        p1 = jax.tree.map(lambda x: jax.numpy.ones_like(x), agg.global_params)
+        p2 = jax.tree.map(lambda x: 3 * jax.numpy.ones_like(x), agg.global_params)
+        p3 = jax.tree.map(lambda x: 9 * jax.numpy.ones_like(x), agg.global_params)
+        agg.begin_round([0, 1, 2])
+        for i, p in enumerate((p1, p2, p3)):
+            agg.receive_upload(i, 10.0, model_params=p)
+        assert agg.peak_buffered == 3  # full cohort buffered (fallback)
+        out = agg.aggregate()
+        jax.tree.map(
+            lambda x: np.testing.assert_allclose(np.asarray(x), 3.0),
+            out,
+        )
+
+
+class TestStreamingEqualsBuffered:
+    @pytest.mark.slow  # two LOCAL worlds (>4s fast-gate budget)
+    def test_stream_world_bit_identical_to_buffered_world(self, args_factory):
+        """The tentpole's acceptance gate in miniature: the same
+        federation run with agg_mode=stream (fold on arrival, arrival
+        order nondeterministic) and agg_mode=buffered (sorted fold at
+        close) lands on the SAME global model bit-for-bit."""
+        Telemetry.reset()
+        buffered = _run_world(
+            args_factory, run_id="sb_buf", backend="LOCAL",
+            agg_mode="buffered",
+        )
+        assert buffered.aggregator.peak_buffered == 4  # O(cohort) baseline
+        Telemetry.reset()
+        streamed = _run_world(
+            args_factory, run_id="sb_str", backend="LOCAL",
+            agg_mode="stream",
+        )
+        assert streamed.aggregator.peak_buffered == 0  # O(model) streaming
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            buffered.aggregator.get_global_model_params(),
+            streamed.aggregator.get_global_model_params(),
+        )
+
+    @pytest.mark.slow  # two LOCAL worlds (>4s fast-gate budget)
+    def test_stream_equals_buffered_with_compression(self, args_factory):
+        """Same gate with int8 quantized uplinks: the fused decode+fold
+        executable is shared by both modes, so bits still match."""
+        Telemetry.reset()
+        buffered = _run_world(
+            args_factory, run_id="sbc_buf", backend="LOCAL",
+            agg_mode="buffered", compression="int8",
+        )
+        Telemetry.reset()
+        streamed = _run_world(
+            args_factory, run_id="sbc_str", backend="LOCAL",
+            agg_mode="stream", compression="int8",
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            buffered.aggregator.get_global_model_params(),
+            streamed.aggregator.get_global_model_params(),
+        )
+
+
+class TestQuorumClose:
+    @pytest.mark.slow  # LOCAL world with a sleeper + a kill (>4s budget)
+    def test_quorum_closes_past_delayed_and_killed_clients(self, args_factory):
+        """One client delayed past the grace window and one killed
+        without OFFLINE (kill -9 analog): the round must close on the
+        quorum — the sleeper is dropped by the grace timer, the corpse
+        leaves the quorum denominator via the failure detector — and
+        late uploads are discarded by round tag."""
+        from fedml_tpu.cross_silo import Client, Server
+
+        Telemetry.reset()
+        kw = dict(
+            comm_round=2,
+            round_quorum_frac=0.5,
+            round_grace_s=1.0,
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=1.0,
+        )
+        a0, ds0, m0 = _build_node(args_factory, "qc1", 0, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, 5):
+            a, ds, m = _build_node(args_factory, "qc1", r, **kw)
+            clients.append(Client(a, None, ds, m))
+
+        # rank 3 is slow: sleeps well past the grace each round
+        slow = clients[2].trainer
+        orig_train = slow.train
+
+        def slow_train(params, round_idx):
+            time.sleep(8.0)
+            return orig_train(params, round_idx)
+
+        slow.train = slow_train
+
+        # rank 2 dies mid-round-0 without OFFLINE
+        victim = clients[1]
+        orig_tas = victim.manager._train_and_send
+
+        def kill(msg):
+            victim.manager._heartbeat.stop()
+            raise _Killed()
+
+        victim.manager._train_and_send = kill
+
+        def client_thread(c):
+            try:
+                c.run()
+            except _Killed:
+                pass
+
+        threads = [
+            threading.Thread(target=client_thread, args=(c,), daemon=True)
+            for c in clients
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        server.run()
+        wall = time.monotonic() - t0
+        for t in threads:
+            t.join(timeout=60)
+        mgr = server.manager
+        assert mgr.round_idx == 2  # every round completed
+        assert mgr.quorum_closes >= 1  # the grace timer closed a round
+        assert mgr.deaths == 1  # the corpse was declared, not waited on
+        assert mgr.stragglers_dropped >= 1
+        # round wall tracked the quorum, not the 8s sleeper x 2 rounds
+        assert wall < 14.0, f"blocked on the straggler ({wall:.1f}s)"
+        tel = Telemetry.get_instance()
+        assert sum(
+            tel.counters_matching("agg_quorum_closes_total").values()
+        ) >= 1
+
+    def test_late_upload_discarded_and_counted(self, args_factory):
+        """The quorum/deadline late-upload policy: an upload tagged
+        with an already-closed round is discarded by round tag and
+        counted in agg_late_uploads_total — never folded."""
+        from fedml_tpu.cross_silo.horizontal.fedml_aggregator import (
+            FedMLAggregator,
+        )
+        from fedml_tpu.cross_silo.horizontal.fedml_server_manager import (
+            FedMLServerManager,
+        )
+
+        Telemetry.reset()
+        a, ds, m = _build_node(args_factory, "late1", 0)
+        agg = FedMLAggregator(a, m)
+        mgr = FedMLServerManager(a, agg, rank=0, size=5, backend="LOCAL")
+        mgr.round_idx = 5
+        up = Message(constants.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 2, 0)
+        up.add_params(constants.MSG_ARG_KEY_ROUND_INDEX, 3)  # stale round
+        up.add_params(constants.MSG_ARG_KEY_MODEL_PARAMS, agg.global_params)
+        up.add_params(constants.MSG_ARG_KEY_NUM_SAMPLES, 10.0)
+        mgr.handle_message_receive_model_from_client(up)
+        assert agg.num_received() == 0  # never folded
+        tel = Telemetry.get_instance()
+        assert sum(
+            tel.counters_matching("agg_late_uploads_total").values()
+        ) == 1
+        mgr.com_manager.stop_receive_message()
+
+    def test_quorum_denominator_shrinks_with_client_num(self, args_factory):
+        """Unit: quorum target follows the live cohort size the failure
+        detector shrinks (drop_expected), so a dead rank stops counting
+        against the quorum."""
+        import fedml_tpu
+        from fedml_tpu import models
+        from fedml_tpu.cross_silo.horizontal.fedml_aggregator import (
+            FedMLAggregator,
+        )
+        from fedml_tpu.data import load
+
+        a = _mk_args(args_factory, "qd1", "LOCAL", round_quorum_frac=0.75)
+        a.rank = 0
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        agg = FedMLAggregator(a, m)
+        agg.begin_round([0, 1, 2, 3])
+        assert agg.quorum_target(0.75) == 3
+        p = agg.global_params
+        agg.receive_upload(0, 10.0, model_params=p)
+        agg.receive_upload(1, 10.0, model_params=p)
+        assert not agg.quorum_met(0.75)
+        # the detector declares rank 4 (index 3) dead: 0.75 * 3 -> 3,
+        # ceil -> 3... with 3 alive the target is ceil(2.25)=3? No:
+        # client_num shrinks to 3, target ceil(0.75*3) = 3 > 2 folded.
+        # Another death (index 2) shrinks to 2: target ceil(1.5)=2 == met.
+        assert agg.drop_expected(3)
+        assert agg.quorum_target(0.75) == 3
+        assert not agg.quorum_met(0.75)
+        assert agg.drop_expected(2)
+        assert agg.quorum_target(0.75) == 2
+        assert agg.quorum_met(0.75)
+        assert agg.missing_indexes() == []
 
 
 # ---------------------------------------------------------------------
@@ -593,11 +986,12 @@ class TestServerRestartResync:
             t.join(timeout=90)
         assert not any(t.is_alive() for t in threads), "clients hung"
         assert server2.manager.round_idx == 3
-        # the WAL saw every completed round across both incarnations
-        rounds_logged = [
-            r["round_idx"] for r in server2.manager._wal.records()
-        ]
+        # the WAL saw every completed round across both incarnations,
+        # each with its folded set (full cohort here — no quorum close)
+        recs = server2.manager._wal.records()
+        rounds_logged = [r["round_idx"] for r in recs]
         assert rounds_logged == [0, 1, 2]
+        assert all(r["folded"] == [1, 2, 3, 4] for r in recs)
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-6
