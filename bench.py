@@ -80,7 +80,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 # windows on rc!=0 children.
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
-    "telemetry", "serving", "chaos", "tracing", "straggler",
+    "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -1594,6 +1594,286 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
+    """Defense phase (docs/robustness.md threat model): poisoned LOCAL
+    worlds proving Byzantine robustness is first-class on the
+    streaming/async path —
+
+    1. **clip bit-identity**: two CLEAN worlds with
+       ``defense_type=norm_diff_clipping`` — ``agg_mode=buffered`` vs
+       ``agg_mode=stream``. Final params must be BIT-IDENTICAL (the
+       clip rides the shared per-term executables) with
+       ``agg_stream_fallback_total == 0`` and stream peak buffered
+       uploads 0 — the defense no longer costs O(cohort·model).
+    2. **clean / undefended-poisoned baselines** (``data/poison.py``:
+       one label_flip + one backdoor_pattern attacker): the undefended
+       poisoned world must DIVERGE from the clean run (server eval
+       loss blows up, param distance grows).
+    3. **defended poisoned world** under drop+dup faults with the
+       reliable channel: clipping + anomaly screening quarantine the
+       attacker ranks (``defense_quarantined_total{rank}``), rounds
+       keep completing (a quarantined rank drops through the
+       drop-expected path), the final model lands near the clean run,
+       and exactly-once accounting holds (every aggregated client ==
+       exactly one fold; duplicates counted, never folded twice).
+    4. **async defended world** (``agg_mode=async``): the
+       construction-time defense rejection is gone — staleness-aware
+       clipping + screening run per fold, the attacker is quarantined,
+       the fold target is reached, and the published model lands near
+       the clean run.
+
+    ``smoke`` (CI gate): same worlds at the mini scale."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.data import load
+
+    n_clients = 6
+    rounds = 6
+    train_size = 360 if smoke else 600
+    attacker_idxs = [1, 4]  # silo idx == rank-1 (identity mapping)
+    attacks = ["label_flip", "backdoor_pattern"]
+    attacker_ranks = [i + 1 for i in attacker_idxs]
+    poison_kw = dict(
+        poison_type=attacks,
+        poisoned_client_idxs=attacker_idxs,
+        poison_sample_fraction=1.0,
+    )
+    # split deliberately: the anomaly screen's DECISIONS are
+    # arrival-order dependent (docs/robustness.md), so the bit-identity
+    # world pair runs clip-only — the guarantee under test is
+    # "clipping in the fold", screening rides the defended worlds
+    clip_kw = dict(defense_type="norm_diff_clipping", norm_bound=1.0)
+    defense_kw = dict(
+        defense_anomaly_threshold=0.35,
+        defense_quarantine_rounds=3,
+        **clip_kw,
+    )
+
+    def mk(rank, run_id, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = train_size
+        a.synthetic_test_size = 120
+        a.model = "lr"
+        # homo: honest clients share a data distribution, so the
+        # anomaly screen's consensus-direction signal is the attack,
+        # not the heterogeneity (hetero worlds are exercised in tests)
+        a.partition_method = "homo"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 1
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def run_world(run_id, **kw):
+        Telemetry.reset()
+        a0, ds0, m0 = mk(0, run_id, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, n_clients + 1):
+            a, ds, m = mk(r, run_id, **kw)
+            clients.append(Client(a, None, ds, m))
+        threads = [
+            threading.Thread(target=c.run, daemon=True, name=f"{run_id}-c{i}")
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        server.run()
+        for t in threads:
+            t.join(timeout=120)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(f"{run_id}: threads hung: {hung}")
+        # server eval on the CLEAN test split (poisoning only touches
+        # attacker train shards) — the robustness headline number
+        stats = server.aggregator.test_on_server_for_all_clients(rounds)
+        return server, stats
+
+    def max_diff(a, b):
+        return max(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda x, y: float(
+                        np.max(np.abs(np.asarray(x) - np.asarray(y)))
+                    ),
+                    a, b,
+                )
+            )
+        )
+
+    def param_dist(a, b):
+        return float(
+            np.sqrt(
+                sum(
+                    float(np.sum((np.asarray(x) - np.asarray(y)) ** 2))
+                    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+                )
+            )
+        )
+
+    def quarantined_ranks_from(tel):
+        out = []
+        for key in tel.counters_matching("defense_quarantined_total"):
+            # defense_quarantined_total{rank=N}
+            out.append(int(key.rsplit("rank=", 1)[1].rstrip("}")))
+        return sorted(set(out))
+
+    out = {"device": str(jax.devices()[0]), "clients": n_clients,
+           "rounds": rounds, "attacker_ranks": attacker_ranks,
+           "attacks": attacks}
+
+    # -- 1: clip bit-identity (stream == buffered, zero fallbacks) ----
+    cb, _ = run_world("bench_def_clipbuf", agg_mode="buffered", **clip_kw)
+    cs, _ = run_world("bench_def_clipstr", agg_mode="stream", **clip_kw)
+    tel = Telemetry.get_instance()
+    diff = max_diff(
+        cb.aggregator.get_global_model_params(),
+        cs.aggregator.get_global_model_params(),
+    )
+    out["max_abs_diff_clip_stream_vs_buffered"] = diff
+    out["clip_stream_identical_to_buffered"] = diff == 0.0
+    out["clip_stream_fallbacks"] = sum(
+        tel.counters_matching("agg_stream_fallback_total").values()
+    )
+    out["clip_buffered_peak_buffered"] = cb.aggregator.peak_buffered
+    out["clip_stream_peak_buffered"] = cs.aggregator.peak_buffered
+    out["clipped_uploads"] = cs.aggregator.defense_clipped
+    _progress(
+        f"defense: clip stream-vs-buffered diff {diff} "
+        f"({cs.aggregator.defense_clipped} clipped)"
+    )
+
+    # -- 2: clean vs undefended-poisoned baselines --------------------
+    clean, clean_stats = run_world("bench_def_clean", agg_mode="stream")
+    p_clean = clean.aggregator.get_global_model_params()
+    undef, undef_stats = run_world(
+        "bench_def_undef", agg_mode="stream", **poison_kw
+    )
+    d_undef = param_dist(undef.aggregator.get_global_model_params(), p_clean)
+    out["clean_loss"] = float(clean_stats["loss"])
+    out["undefended_loss"] = float(undef_stats["loss"])
+    out["undefended_dist"] = round(d_undef, 4)
+    out["undefended_diverges"] = (
+        out["undefended_loss"] > 3.0 * out["clean_loss"] and d_undef > 0.1
+    )
+    _progress(
+        f"defense: clean loss {out['clean_loss']:.4f} vs poisoned "
+        f"undefended {out['undefended_loss']:.4f}"
+    )
+
+    # -- 3: defended poisoned world under drop/dup faults -------------
+    defended, def_stats = run_world(
+        "bench_def_def", agg_mode="stream",
+        reliable_comm=True, comm_retry_max=8, comm_retry_base_s=0.05,
+        fault_injection={"drop_prob": 0.15, "duplicate_prob": 0.15, "seed": 5},
+        **poison_kw, **defense_kw,
+    )
+    tel = Telemetry.get_instance()
+
+    def total(counter):
+        return sum(tel.counters_matching(counter).values())
+
+    d_def = param_dist(defended.aggregator.get_global_model_params(), p_clean)
+    quarantined = quarantined_ranks_from(tel)
+    out["defended_loss"] = float(def_stats["loss"])
+    out["defended_dist"] = round(d_def, 4)
+    out["defended_dist_ratio"] = round(d_def / max(d_undef, 1e-9), 4)
+    out["defended_within_bound"] = (
+        out["defended_loss"] < 0.5 * out["undefended_loss"]
+        and d_def < 0.95 * d_undef
+    )
+    out["quarantined_ranks"] = quarantined
+    out["attackers_quarantined"] = all(
+        r in quarantined for r in attacker_ranks
+    )
+    out["honest_quarantined_ranks"] = [
+        r for r in quarantined if r not in attacker_ranks
+    ]
+    out["rounds_completed"] = defended.manager.round_idx
+    out["defense_clipped_total"] = total("defense_clipped_total")
+    out["quarantine_rejected_uploads"] = total(
+        "defense_quarantined_rejected_total"
+    )
+    # exactly-once under dup faults: every aggregated client == exactly
+    # one fold; network duplicates are dropped by the channel and any
+    # survivor is counted by the per-round fold dedup, never refolded
+    folds = total("agg_folds_total")
+    aggregated = total("cross_silo_clients_aggregated_total")
+    out["folds_total"] = folds
+    out["uploads_aggregated"] = aggregated
+    out["dup_uploads_ignored"] = total("agg_dup_uploads_ignored_total")
+    out["comm_dup_dropped"] = total("comm_dup_dropped_total")
+    out["exactly_once"] = folds == aggregated and folds <= n_clients * rounds
+    _progress(
+        f"defense: defended loss {out['defended_loss']:.4f}, quarantined "
+        f"{quarantined} (attackers {attacker_ranks}), "
+        f"{out['rounds_completed']}/{rounds} rounds"
+    )
+
+    # -- 4: async defended world --------------------------------------
+    asrv, async_stats = run_world(
+        "bench_def_async", agg_mode="async", async_publish_every=3,
+        staleness_decay=0.5, staleness_max=64,
+        **poison_kw, **defense_kw,
+    )
+    tel = Telemetry.get_instance()
+    aq = quarantined_ranks_from(tel)
+    d_async = param_dist(asrv.aggregator.get_global_model_params(), p_clean)
+    stale_folds = sum(
+        1 for e in asrv.manager.async_weight_log if e["staleness"] > 0
+    )
+    out["async"] = {
+        "loss": float(async_stats["loss"]),
+        "dist": round(d_async, 4),
+        "quarantined_ranks": aq,
+        "attacker_quarantined": any(r in aq for r in attacker_ranks),
+        "honest_quarantined_ranks": [r for r in aq if r not in attacker_ranks],
+        "folds_total": asrv.manager.async_folds,
+        "target_folds": asrv.manager._async_target_folds(),
+        "publishes": asrv.manager.version,
+        "stale_folds": stale_folds,
+        "clipped_uploads": asrv.aggregator.defense_clipped,
+        "quarantine_rejected_uploads": sum(
+            tel.counters_matching(
+                "defense_quarantined_rejected_total"
+            ).values()
+        ),
+        "defended_within_bound": (
+            float(async_stats["loss"]) < 0.5 * out["undefended_loss"]
+        ),
+    }
+    _progress(
+        f"defense: async loss {out['async']['loss']:.4f}, quarantined {aq}, "
+        f"{asrv.manager.async_folds}/{asrv.manager._async_target_folds()} folds"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
     """Tracing phase (docs/observability.md): a LOCAL multi-client
     cross-silo world run twice — telemetry OFF, then distributed
@@ -1970,6 +2250,10 @@ _TRACING_TIMEOUT_S = 300.0
 # async with faults + kill + restart); the quorum world deliberately
 # waits out grace windows and the async drain rides the straggler
 _STRAGGLER_TIMEOUT_S = 360.0
+# six LOCAL worlds (clip stream/buffered pair, clean, poisoned
+# undefended, poisoned defended under drop/dup faults, poisoned async)
+# — all mini LR cohorts; dominated by jit compiles on a cold box
+_DEFENSE_TIMEOUT_S = 360.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -2251,6 +2535,12 @@ def _main_guarded() -> None:
     # async exactly-once folds with oracle-checked staleness weights
     # under faults + kill + server restart
     _run_demoted_phase("straggler", _STRAGGLER_TIMEOUT_S)
+    # defense phase (Byzantine robustness on the streaming path):
+    # poisoned worlds — clipping bit-identical stream vs buffered with
+    # zero fallbacks, undefended divergence vs defended recovery,
+    # attacker quarantine through the drop-expected path, async
+    # staleness-aware defenses, exactly-once accounting intact
+    _run_demoted_phase("defense", _DEFENSE_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -2396,6 +2686,8 @@ def _phase_main(argv) -> None:
         out = run_tracing(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "straggler":
         out = run_straggler(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "defense":
+        out = run_defense(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -67,3 +67,16 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_tracing_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Defense smoke (6 clients x 6 rounds, poisoned worlds, CPU): Byzantine
+# robustness on the streaming path must run end-to-end through
+# bench.py's defense phase child and emit the detail.defense contract
+# keys — norm-diff clipping bit-identical between stream and buffered
+# with zero loud fallbacks, the undefended poisoned world diverging
+# while the defended one (clipping + anomaly quarantine under drop/dup
+# faults) recovers with the attacker ranks quarantined, async
+# staleness-aware defenses reaching the fold target, and exactly-once
+# fold accounting intact.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_defense_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

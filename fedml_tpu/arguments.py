@@ -158,10 +158,43 @@ _DEFAULTS: Dict[str, Any] = {
     # OFFLINE/deadline-cohort paths so a kill -9'd client can never
     # stall a round. Use 3-5x heartbeat_interval_s. 0 disables
     "heartbeat_timeout_s": 0.0,
-    # robustness (reference: fedavg_robust example config)
+    # robustness (reference: fedavg_robust example config). defense_type:
+    # "norm_diff_clipping" | "weak_dp" | "median" | None. Clipping and
+    # weak_dp are per-upload and ride the streaming/async fold
+    # (core/aggregation.py clipped term executables; weak-DP noise
+    # drawn at finalize from a run-seed+round key); median needs the
+    # full cohort and keeps the buffered path. Unknown strings are
+    # rejected loudly — never silently aggregated undefended.
     "defense_type": None,
+    # norm-diff clip radius: each upload's delta against the broadcast
+    # global is scaled to at most this L2 norm
     "norm_bound": 5.0,
+    # weak-DP Gaussian noise stddev added to the finalized aggregate
     "stddev": 0.158,
+    # on-arrival anomaly screen (core/defense.py AnomalyScreen): uploads
+    # are scored (norm excess + cosine to the running aggregate) into a
+    # per-rank reputation EWMA; a rank whose reputation crosses this
+    # threshold is QUARANTINED — uploads rejected before folding, rank
+    # excluded from cohorts until probation expires. 0 disables. Note
+    # screening decisions are arrival-order dependent, so the
+    # stream==buffered bit-identity guarantee applies with 0 only
+    "defense_anomaly_threshold": 0.0,
+    # quarantine probation length, in round closes (sync) or publishes
+    # (async); release restores a fresh reputation
+    "defense_quarantine_rounds": 3,
+    # poisoned-world synthesis (data/poison.py, loader wiring): attack
+    # type for the attacker clients — "label_flip" | "targeted_flip" |
+    # "backdoor_pattern" | "edge_case", or a list paired 1:1 with
+    # poisoned_client_idxs for mixed-attack worlds. None disables
+    "poison_type": None,
+    # explicit attacker client indexes (wins over the fraction)
+    "poisoned_client_idxs": None,
+    # else: this fraction of clients is drawn as attackers (seeded)
+    "poisoned_client_fraction": 0.0,
+    # label the attacks steer toward (backdoor/edge_case/targeted_flip)
+    "target_label": 0,
+    # fraction of each attacker's samples that are poisoned
+    "poison_sample_fraction": 1.0,
     # precision: the 3-decimal equivalence oracles need f32 matmuls
     "matmul_precision": "highest",
     # mixed precision (core/local_trainer.py): "bfloat16" runs the
@@ -463,6 +496,84 @@ class Arguments:
                 "agg_mode=async has no round barrier; "
                 "aggregation_deadline_s does not apply — unset one of them"
             )
+        # -- defense / attack knobs (docs/robustness.md threat model) --
+        defense = getattr(self, "defense_type", None) or None
+        if defense is not None and defense not in constants.DEFENSE_TYPES:
+            # the silent-no-defense footgun: a typo'd defense_type used
+            # to fall through to a plain undefended mean
+            raise ValueError(
+                f"unknown defense_type {defense!r}; pick one of "
+                f"{constants.DEFENSE_TYPES} (or null to disable)"
+            )
+        for float_key in (
+            "norm_bound", "stddev", "defense_anomaly_threshold",
+            "poisoned_client_fraction", "poison_sample_fraction",
+        ):
+            raw = getattr(self, float_key)
+            try:
+                setattr(self, float_key, float(raw))
+            except (TypeError, ValueError):
+                # a YAML `norm_bound: null` must name the knob, not
+                # surface a bare float(None) TypeError
+                raise ValueError(
+                    f"{float_key}={raw!r}: must be a number"
+                ) from None
+        if self.norm_bound <= 0:
+            raise ValueError(
+                f"norm_bound={self.norm_bound}: must be > 0 (the clip "
+                "radius around the global model)"
+            )
+        if self.stddev < 0:
+            raise ValueError(f"stddev={self.stddev}: must be >= 0")
+        if self.defense_anomaly_threshold < 0:
+            raise ValueError(
+                f"defense_anomaly_threshold={self.defense_anomaly_threshold}: "
+                "must be >= 0 (0 disables the anomaly screen)"
+            )
+        raw = self.defense_quarantine_rounds
+        try:
+            self.defense_quarantine_rounds = int(raw)
+        except (TypeError, ValueError):
+            # same null-naming rule as the float knobs above
+            raise ValueError(
+                f"defense_quarantine_rounds={raw!r}: must be an integer"
+            ) from None
+        if self.defense_quarantine_rounds < 1:
+            raise ValueError(
+                f"defense_quarantine_rounds={self.defense_quarantine_rounds}: "
+                "must be >= 1"
+            )
+        ptypes = getattr(self, "poison_type", None) or None
+        if ptypes is not None:
+            as_list = (
+                list(ptypes) if isinstance(ptypes, (list, tuple)) else [ptypes]
+            )
+            bad = [t for t in as_list if t not in constants.POISON_TYPES]
+            if bad:
+                raise ValueError(
+                    f"unknown poison_type {bad}; pick from "
+                    f"{constants.POISON_TYPES}"
+                )
+            if isinstance(ptypes, (list, tuple)) and not (
+                getattr(self, "poisoned_client_idxs", None)
+            ):
+                raise ValueError(
+                    "poison_type as a list pairs 1:1 with "
+                    "poisoned_client_idxs; set the idxs explicitly "
+                    "(poisoned_client_fraction draws an arbitrary "
+                    "attacker set)"
+                )
+        if not 0.0 <= self.poisoned_client_fraction <= 1.0:
+            raise ValueError(
+                f"poisoned_client_fraction={self.poisoned_client_fraction}: "
+                "must be in [0, 1]"
+            )
+        if not 0.0 < self.poison_sample_fraction <= 1.0:
+            raise ValueError(
+                f"poison_sample_fraction={self.poison_sample_fraction}: "
+                "must be in (0, 1]"
+            )
+        self.target_label = int(getattr(self, "target_label", 0) or 0)
         if self.serve_queue_size < 1 or self.serve_max_batch < 1:
             raise ValueError(
                 f"serve_queue_size={self.serve_queue_size} / "

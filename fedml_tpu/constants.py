@@ -40,6 +40,19 @@ PARTITION_HOMO = "homo"
 PARTITION_HETERO = "hetero"
 PARTITION_HETERO_FIX = "hetero-fix"
 
+# Robust-aggregation defenses (reference robust_aggregation.py:41-99)
+# and the poisoning attacks they defend against (reference
+# data/edge_case_examples/data_loader.py; data/poison.py reproduces the
+# mechanisms). ONE authoritative vocabulary: knob validation
+# (arguments.py), RobustAggregator construction, needs_full_cohort and
+# the poisoned-world loader all check against these — an unknown string
+# fails loudly everywhere instead of silently aggregating undefended.
+DEFENSE_NORM_DIFF_CLIPPING = "norm_diff_clipping"
+DEFENSE_WEAK_DP = "weak_dp"
+DEFENSE_MEDIAN = "median"
+DEFENSE_TYPES = (DEFENSE_NORM_DIFF_CLIPPING, DEFENSE_WEAK_DP, DEFENSE_MEDIAN)
+POISON_TYPES = ("label_flip", "targeted_flip", "backdoor_pattern", "edge_case")
+
 # Federated optimizers
 FED_OPTIMIZER_FEDAVG = "FedAvg"
 FED_OPTIMIZER_FEDOPT = "FedOpt"

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import constants
+
 Params = Any  # pytree of jax.Array
 
 
@@ -221,6 +223,114 @@ def _weighted_term_decoded(codec, encoded, like: Params, w: jax.Array) -> Params
     return jax.tree.map(lambda d: w * d.astype(jnp.float32), delta)
 
 
+# -- streamable defenses (norm_diff_clipping / weak_dp) ----------------
+#
+# The reference's RobustAggregator clips each client's DELTA against
+# the global model, then averages — a per-client operation that never
+# needed the stacked cohort. These executables move the clip INSIDE the
+# per-upload term step, so the defenses ride the aggregate-on-arrival
+# fold at O(model) memory: term_i = w_i * (g + delta_i * min(1,
+# bound/||delta_i||)). The clip's multiplies live in the TERM jit (pure
+# function of one upload — deterministic per (upload, g, bound, w)
+# regardless of arrival order), never in the add-only FOLD jit, so the
+# error-free-transformation argument above is untouched and
+# stream == buffered stays bitwise. weak_dp = the same clip + Gaussian
+# noise on the FINALIZED aggregate (see RobustAggregator.add_noise;
+# the cross-silo aggregator draws the key from run seed + round via
+# ``derive_defense_rng`` at finalize). Each executable also returns the
+# pre-clip delta norm and whether the clip bound actually bit — the
+# on-arrival anomaly screen and ``defense_clipped_total`` read them
+# without a second pass over the model.
+
+
+def _clip_scale(norm: jax.Array, bound: jax.Array) -> jax.Array:
+    """min(1, bound/||delta||) — robust_aggregation.py:47-58 semantics
+    (shared with RobustAggregator.clip_updates; eps guards a zero
+    delta)."""
+    return jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
+
+
+@jax.jit
+def _weighted_term_clipped(
+    theta: Params, g: Params, bound: jax.Array, w: jax.Array
+):
+    """Clip-against-global + weight, fused: t = w * (g + delta *
+    min(1, bound/||delta||)). Returns (term, pre-clip norm, clipped?)."""
+    delta = jax.tree.map(
+        lambda t, gg: t.astype(jnp.float32) - gg.astype(jnp.float32), theta, g
+    )
+    norm = global_norm(delta)
+    scale = _clip_scale(norm, bound)
+    term = jax.tree.map(
+        lambda gg, d: w * (gg.astype(jnp.float32) + d * scale), g, delta
+    )
+    return term, norm, norm > bound
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _weighted_term_encoded_clipped(
+    codec, encoded, like: Params, bound: jax.Array, w: jax.Array
+):
+    """Fused decode + clip + reconstruct + weight: the wire payload IS
+    the delta against the broadcast global, so the clip applies to the
+    decoded tree directly."""
+    from .compression import decode_delta
+
+    delta = jax.tree.map(
+        lambda d: d.astype(jnp.float32), decode_delta(codec, encoded, like)
+    )
+    norm = global_norm(delta)
+    scale = _clip_scale(norm, bound)
+    term = jax.tree.map(
+        lambda gg, d: w * (gg.astype(jnp.float32) + d * scale), like, delta
+    )
+    return term, norm, norm > bound
+
+
+@jax.jit
+def _weighted_delta_term_clipped(delta: Params, bound: jax.Array, w: jax.Array):
+    """Async-mode clip: the fold currency is the delta itself, so the
+    clipped term is w * delta * min(1, bound/||delta||) — the staleness
+    discount rides ``w`` and never changes the clip geometry."""
+    d32 = jax.tree.map(lambda x: x.astype(jnp.float32), delta)
+    norm = global_norm(d32)
+    scale = _clip_scale(norm, bound)
+    term = jax.tree.map(lambda d: w * (d * scale), d32)
+    return term, norm, norm > bound
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _weighted_delta_term_decoded_clipped(
+    codec, encoded, like: Params, bound: jax.Array, w: jax.Array
+):
+    """Fused decode + clip + weight of an async update delta (``like``
+    supplies shapes only)."""
+    from .compression import decode_delta
+
+    d32 = jax.tree.map(
+        lambda d: d.astype(jnp.float32), decode_delta(codec, encoded, like)
+    )
+    norm = global_norm(d32)
+    scale = _clip_scale(norm, bound)
+    term = jax.tree.map(lambda d: w * (d * scale), d32)
+    return term, norm, norm > bound
+
+
+@jax.jit
+def _tree_scaled(tree: Params, denom: jax.Array) -> Params:
+    return jax.tree.map(lambda x: x / denom, tree)
+
+
+def derive_defense_rng(seed, index) -> jax.Array:
+    """THE defense rng convention: fold the round/publish index into the
+    run seed. Every weak_dp call site derives its key here — the seed's
+    ``rng=None -> PRNGKey(0)`` default added the IDENTICAL "noise"
+    every round, which is no privacy at all (satellite fix)."""
+    return jax.random.fold_in(
+        jax.random.PRNGKey(int(seed)), int(index) % (2**31)
+    )
+
+
 class StreamingAccumulator:
     """Incremental weighted-sum fold over model uploads: O(model)
     memory, order-independent finalize.
@@ -255,6 +365,59 @@ class StreamingAccumulator:
         self._fold_term(
             _weighted_term_decoded(codec, encoded, like, jnp.float32(w)), w
         )
+
+    # -- defense folds (norm_diff_clipping / weak_dp in the stream) ---
+    # Each clips the upload's delta against the broadcast global INSIDE
+    # the fused term step, folds the clipped term, and reports
+    # (pre-clip delta norm, clip bound bit?) so the caller can feed the
+    # anomaly screen and defense_clipped_total without re-walking the
+    # model. The buffered path folds through these SAME executables at
+    # close, which is what keeps stream == buffered bitwise for
+    # clipping configs.
+
+    def fold_clipped(
+        self, theta: Params, against: Params, bound: float, w: float
+    ) -> Tuple[float, bool]:
+        term, norm, clipped = _weighted_term_clipped(
+            theta, against, jnp.float32(bound), jnp.float32(w)
+        )
+        self._fold_term(term, w)
+        return float(norm), bool(clipped)
+
+    def fold_encoded_clipped(
+        self, codec, encoded: Params, like: Params, bound: float, w: float
+    ) -> Tuple[float, bool]:
+        term, norm, clipped = _weighted_term_encoded_clipped(
+            codec, encoded, like, jnp.float32(bound), jnp.float32(w)
+        )
+        self._fold_term(term, w)
+        return float(norm), bool(clipped)
+
+    def fold_delta_clipped(
+        self, delta: Params, bound: float, w: float
+    ) -> Tuple[float, bool]:
+        term, norm, clipped = _weighted_delta_term_clipped(
+            delta, jnp.float32(bound), jnp.float32(w)
+        )
+        self._fold_term(term, w)
+        return float(norm), bool(clipped)
+
+    def fold_encoded_delta_clipped(
+        self, codec, encoded: Params, like: Params, bound: float, w: float
+    ) -> Tuple[float, bool]:
+        term, norm, clipped = _weighted_delta_term_decoded_clipped(
+            codec, encoded, like, jnp.float32(bound), jnp.float32(w)
+        )
+        self._fold_term(term, w)
+        return float(norm), bool(clipped)
+
+    def running_mean(self) -> Optional[Params]:
+        """Approximate mean of everything folded so far (top limb only
+        — a scoring aid for the on-arrival anomaly screen, NOT the
+        exact finalize). None before the first fold."""
+        if self.count == 0:
+            return None
+        return _tree_scaled(self._limbs[0], jnp.float32(self.total_w))
 
     def _fold_term(self, term: Params, w: float) -> None:
         self._limbs = _fold_tree(self._limbs, term)
@@ -312,13 +475,22 @@ def needs_full_cohort(args, server_aggregator) -> Optional[str]:
 
     The incremental fold is a weighted sum; an aggregator that needs
     the whole cohort at once (coordinate-wise median, a custom
-    ``ServerAggregator`` reduction, norm-clipping against per-client
-    deltas) must keep the buffered path — loudly, never silently."""
+    ``ServerAggregator`` reduction) must keep the buffered path —
+    loudly, never silently. ``norm_diff_clipping`` and ``weak_dp`` are
+    per-upload operations (clip inside the term step, noise at
+    finalize) and STREAM — see the clipped term executables above.
+    Unknown defense strings are rejected here, not quietly averaged."""
     if server_aggregator is not None:
         return "custom ServerAggregator reduces over the stacked cohort"
-    defense = getattr(args, "defense_type", None)
-    if defense:
-        return f"defense_type={defense} needs the full cohort at once"
+    defense = getattr(args, "defense_type", None) or None
+    if defense is not None and defense not in constants.DEFENSE_TYPES:
+        raise ValueError(
+            f"unknown defense_type {defense!r}; pick one of "
+            f"{constants.DEFENSE_TYPES} (or None) — refusing to fall "
+            "through to an UNDEFENDED plain mean"
+        )
+    if defense == constants.DEFENSE_MEDIAN:
+        return "defense_type=median needs the full cohort at once"
     return None
 
 
@@ -330,9 +502,25 @@ class RobustAggregator:
     """
 
     def __init__(self, args) -> None:
-        self.defense_type = getattr(args, "defense_type", None)
+        defense = getattr(args, "defense_type", None) or None
+        if defense is not None and defense not in constants.DEFENSE_TYPES:
+            # the seed's aggregate() silently fell through to a plain
+            # mean on a typo'd defense — a no-defense footgun. Reject
+            # at construction instead.
+            raise ValueError(
+                f"unknown defense_type {defense!r}; pick one of "
+                f"{constants.DEFENSE_TYPES} (or None)"
+            )
+        self.defense_type = defense
         self.norm_bound = float(getattr(args, "norm_bound", 5.0))
         self.stddev = float(getattr(args, "stddev", 0.158))
+        if self.norm_bound <= 0:
+            raise ValueError(
+                f"norm_bound={self.norm_bound}: must be > 0 (the clip "
+                "radius around the global model)"
+            )
+        if self.stddev < 0:
+            raise ValueError(f"stddev={self.stddev}: must be >= 0")
 
     def clip_updates(self, stacked: Params, global_params: Params) -> Params:
         """Norm-difference clipping (robust_aggregation.py:47-58):
@@ -381,6 +569,14 @@ class RobustAggregator:
         out = weighted_average(stacked, weights)
         if self.defense_type == "weak_dp":
             if rng is None:
-                rng = jax.random.PRNGKey(0)
+                # the seed defaulted to PRNGKey(0) here, so every round
+                # added the IDENTICAL "noise" — zero privacy. Callers
+                # must derive the key from run seed + round index
+                # (``derive_defense_rng``).
+                raise ValueError(
+                    "weak_dp needs a per-round rng; pass "
+                    "derive_defense_rng(args.random_seed, round_idx) — "
+                    "a fixed key re-adds the same noise every round"
+                )
             out = self.add_noise(out, rng)
         return out

@@ -14,12 +14,30 @@ scaling with the cohort and the post-barrier aggregate shrinks to a
 finalize. The fold is bit-order-independent, so streaming results are
 bit-identical to ``agg_mode: buffered`` (which routes its sorted
 buffer through the same fold). Aggregations that need the whole cohort
-at once — ``defense_type`` or a custom ``ServerAggregator`` — fall
-back to the buffered path LOUDLY: one warning plus the
+at once — ``defense_type: median`` or a custom ``ServerAggregator`` —
+fall back to the buffered path LOUDLY: one warning plus the
 ``agg_stream_fallback_total`` counter, never a silent wrong answer.
 ``agg_mode: async`` (FedBuff-style, see the server manager) folds with
 staleness-discounted weights through the same accumulator and never
 clears a cohort barrier at all.
+
+**Byzantine robustness on the streaming path** (docs/robustness.md
+threat model): ``norm_diff_clipping`` and ``weak_dp`` are per-upload
+defenses and RIDE the fold — each upload's delta is clipped against
+the broadcast global inside the fused term jit before accumulation
+(``defense_clipped_total``), and weak-DP noise is drawn once at
+finalize from a run-seed + round derived key
+(``core.aggregation.derive_defense_rng``). The buffered close folds
+through the same clipped executables, so stream == buffered stays
+bitwise for these configs and ``agg_stream_fallback_total`` stays 0.
+On top, an optional on-arrival anomaly screen
+(``core/defense.py`` ``AnomalyScreen``, ``defense_anomaly_threshold``)
+scores every upload (norm excess + cosine to the running aggregate),
+keeps a per-rank reputation, and QUARANTINES ranks past the threshold:
+their uploads are rejected before folding
+(``defense_quarantined_total{rank}``) and the server manager excludes
+them from cohorts until probation (``defense_quarantine_rounds``)
+expires.
 """
 
 from __future__ import annotations
@@ -31,8 +49,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ... import constants
 from ...core.aggregation import (
+    RobustAggregator,
     StreamingAccumulator,
+    derive_defense_rng,
     needs_full_cohort,
     normalize_weights,
     stack_pytrees,
@@ -73,6 +94,33 @@ class FedMLAggregator:
         self._tel = Telemetry.get_instance(args)
         self._codec = make_codec(args)
         self.agg_mode = str(getattr(args, "agg_mode", "stream"))
+        # -- Byzantine defenses (docs/robustness.md threat model) ------
+        # RobustAggregator construction validates defense_type /
+        # norm_bound / stddev loudly; needs_full_cohort below rejects
+        # unknown strings too, so a typo can never aggregate undefended
+        from ...core.defense import AnomalyScreen
+
+        self._robust = (
+            RobustAggregator(args)
+            if (getattr(args, "defense_type", None) or None) is not None
+            else None
+        )
+        # clipping/weak_dp stream per-upload; median/custom stay buffered
+        self._clip_streaming = self._robust is not None and (
+            self._robust.defense_type
+            in (
+                constants.DEFENSE_NORM_DIFF_CLIPPING,
+                constants.DEFENSE_WEAK_DP,
+            )
+        )
+        self.screen = AnomalyScreen(args)
+        self.defense_clipped = 0  # uploads whose clip bound actually bit
+        self.defense_rejected = 0  # uploads rejected by quarantine
+        # buffered/fallback modes have no accumulator until close, so
+        # the screen's cosine reference is this screening-only weighted
+        # sum of accepted deltas (cosine is scale-invariant — the
+        # unnormalized sum carries the same direction a mean would)
+        self._screen_ref: Optional[Params] = None
         self._fallback_reason = needs_full_cohort(args, self.server_aggregator)
         if self.agg_mode == "async" and self._fallback_reason:
             raise ValueError(
@@ -118,9 +166,13 @@ class FedMLAggregator:
         model_params: Optional[Params] = None,
         encoded: Optional[Params] = None,
         weight_scale: float = 1.0,
-    ) -> None:
+    ) -> str:
         """One client upload landed: fold it NOW (streaming/async) or
-        buffer it (buffered / full-cohort fallback).
+        buffer it (buffered / full-cohort fallback). Returns a status —
+        ``"folded"`` / ``"buffered"`` / ``"duplicate"`` /
+        ``"quarantined"`` — so the manager can route a rejected rank
+        through the drop-expected path (a quarantined rank must not
+        stall the quorum grace).
 
         Exactly one of ``model_params`` (full tree) / ``encoded``
         (compressed delta against the current global tree) is given.
@@ -146,12 +198,31 @@ class FedMLAggregator:
                 "duplicate upload from index %d ignored (already folded "
                 "this round)", index,
             )
-            return
+            return "duplicate"
         payload = model_params if model_params is not None else encoded
         payload = reconcile_to_device(payload)
         w = float(sample_num) * float(weight_scale)
+        if self.screen.enabled and self._screen_upload(
+            index, payload, raw=model_params is not None, delta_mode=False,
+            w=w,
+        ):
+            return "quarantined"
         if self.streaming:
-            if model_params is not None:
+            if self._clip_streaming:
+                # defense in the fold: clip against the broadcast
+                # global inside the fused term step (stream == buffered
+                # stays bitwise — the close folds the same executables)
+                bound = self._robust.norm_bound
+                if model_params is not None:
+                    _, clipped = self._accumulator().fold_clipped(
+                        payload, self.global_params, bound, w
+                    )
+                else:
+                    _, clipped = self._accumulator().fold_encoded_clipped(
+                        self._codec, payload, self.global_params, bound, w
+                    )
+                self._note_clipped(clipped)
+            elif model_params is not None:
                 self._accumulator().fold(payload, w)
             else:
                 self._accumulator().fold_encoded(
@@ -168,13 +239,136 @@ class FedMLAggregator:
         self._folded.add(index)
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
+        return "folded" if self.streaming else "buffered"
+
+    # -- defense plumbing (clip counters + anomaly screen) ------------
+    def _note_clipped(self, clipped: bool) -> None:
+        if clipped:
+            self.defense_clipped += 1
+            self._tel.inc("defense_clipped_total")
+
+    def _screen_upload(
+        self,
+        index: int,
+        payload: Params,
+        raw: bool,
+        delta_mode: bool,
+        staleness: int = 0,
+        w: float = 1.0,
+    ) -> bool:
+        """Score one upload for the anomaly screen; True -> REJECT (the
+        rank is quarantined — already, or this upload just tripped it).
+        ``delta_mode`` says the payload is an update delta (async)
+        rather than a full model (sync); ``staleness`` makes the screen
+        staleness-aware (catch-up norms are expected, not anomalous).
+
+        Cost note: with a codec configured this decodes the payload a
+        SECOND time (the accepted fold decodes again inside its fused
+        executable). Deliberate: scoring must happen BEFORE folding (a
+        rejected upload never touches the accumulator), and routing the
+        fold through a pre-decoded delta would put stream and buffered
+        on different executables, forfeiting their bit-identity. The
+        extra O(model) pass only exists when screening is enabled."""
+        from ...core.defense import decoded_delta, delta_from
+
+        if self.screen.is_quarantined(index):
+            self.defense_rejected += 1
+            self._tel.inc("defense_quarantined_rejected_total")
+            logging.warning(
+                "defense: rejecting upload from quarantined index %d", index
+            )
+            return True
+        if delta_mode:
+            d = (
+                payload
+                if raw
+                else decoded_delta(self._codec, payload, self.global_params)
+            )
+            # async running aggregate IS a (weighted) mean delta
+            running = (
+                self._acc.running_mean() if self._acc is not None else None
+            )
+        else:
+            d = (
+                delta_from(payload, self.global_params)
+                if raw
+                else decoded_delta(self._codec, payload, self.global_params)
+            )
+            rm = (
+                self._acc.running_mean()
+                if (self.streaming and self._acc is not None)
+                else None
+            )
+            # sync running aggregate is a mean MODEL; compare deltas.
+            # Buffered/fallback: the screening-only running delta sum
+            # (no accumulator exists until close)
+            running = (
+                delta_from(rm, self.global_params)
+                if rm is not None
+                else (None if self.streaming else self._screen_ref)
+            )
+        score, norm, _cos = self.screen.score_upload(
+            d, running, staleness=staleness
+        )
+        self._tel.observe(
+            "defense_anomaly_score", score,
+            buckets=(0.05, 0.1, 0.2, 0.4, 0.8, 1.6),
+        )
+        if self.screen.observe(index, score, norm):
+            self.defense_rejected += 1
+            self._tel.inc("defense_quarantined_total", rank=index + 1)
+            self._tel.inc("defense_quarantined_rejected_total")
+            return True
+        if not delta_mode and not self.streaming:
+            # accepted: extend the buffered-mode cosine reference
+            term = jax.tree.map(lambda x: w * x, d)
+            self._screen_ref = (
+                term
+                if self._screen_ref is None
+                else jax.tree.map(jnp.add, self._screen_ref, term)
+            )
+        return False
+
+    def quarantined_ranks(self):
+        """Transport ranks currently quarantined (the manager excludes
+        them from broadcasts and the quorum denominator)."""
+        return {i + 1 for i in self.screen.quarantined_indexes()}
+
+    def tick_defense(self):
+        """One probation period elapsed (round close / async publish).
+        Returns the released aggregator indexes."""
+        if not self.screen.enabled:
+            return []
+        return self.screen.tick()
+
+    def _apply_weak_dp(self, params: Params) -> Params:
+        """Weak-DP noise at finalize — run-seed + round derived key
+        (``derive_defense_rng``), never a fixed key. A custom
+        ``ServerAggregator`` owns its whole reduction including any
+        defense, so it is exempt."""
+        if (
+            self._robust is None
+            or self._robust.defense_type != constants.DEFENSE_WEAK_DP
+            or self.server_aggregator is not None
+        ):
+            return params
+        rng = derive_defense_rng(
+            getattr(self.args, "random_seed", 0), self._agg_round
+        )
+        self._tel.inc("defense_noise_rounds_total")
+        return self._robust.add_noise(params, rng)
 
     def add_local_trained_result(
         self, index: int, model_params: Params, sample_num: float
-    ) -> None:
+    ) -> str:
         """(fedml_aggregator.py:58-63) — legacy entry point; routes
-        through ``receive_upload``."""
-        self.receive_upload(index, sample_num, model_params=model_params)
+        through ``receive_upload`` and propagates its status: a
+        screening-enabled caller must route ``"quarantined"`` through
+        drop-expected (see the server manager) or the round waits on a
+        slot that will never fill."""
+        return self.receive_upload(
+            index, sample_num, model_params=model_params
+        )
 
     # -- async (FedBuff-style) fold/publish ---------------------------
     def fold_delta(
@@ -183,17 +377,46 @@ class FedMLAggregator:
         delta: Optional[Params] = None,
         encoded: Optional[Params] = None,
         weight_scale: float = 1.0,
-    ) -> None:
+        index: Optional[int] = None,
+        staleness: int = 0,
+    ) -> str:
         """Fold a staleness-discounted update DELTA (async mode). The
         server applies deltas to whatever the global model is NOW —
         it never stores the stale base params the client trained from,
-        which is what keeps async memory O(model) at any staleness."""
+        which is what keeps async memory O(model) at any staleness.
+
+        With clipping defenses the delta is clipped to ``norm_bound``
+        inside the fused term step BEFORE the staleness weight applies
+        (the discount rides ``weight_scale``, never the clip geometry);
+        with ``index`` given and the anomaly screen enabled the upload
+        is scored first and may come back ``"quarantined"`` — rejected,
+        not folded."""
         from ...core.aggregation import reconcile_to_device
 
         payload = delta if delta is not None else encoded
         payload = reconcile_to_device(payload)
         w = float(sample_num) * float(weight_scale)
-        if delta is not None:
+        if (
+            index is not None
+            and self.screen.enabled
+            and self._screen_upload(
+                index, payload, raw=delta is not None, delta_mode=True,
+                staleness=staleness, w=w,
+            )
+        ):
+            return "quarantined"
+        if self._clip_streaming:
+            bound = self._robust.norm_bound
+            if delta is not None:
+                _, clipped = self._accumulator().fold_delta_clipped(
+                    payload, bound, w
+                )
+            else:
+                _, clipped = self._accumulator().fold_encoded_delta_clipped(
+                    self._codec, payload, self.global_params, bound, w
+                )
+            self._note_clipped(clipped)
+        elif delta is not None:
             self._accumulator().fold(payload, w)
         else:
             self._accumulator().fold_encoded_delta(
@@ -201,6 +424,7 @@ class FedMLAggregator:
             )
         self.folds_total += 1
         self._tel.inc("agg_folds_total", mode=self.agg_mode)
+        return "folded"
 
     def pending_folds(self) -> int:
         return 0 if self._acc is None else self._acc.count
@@ -208,13 +432,16 @@ class FedMLAggregator:
     def publish_async(self) -> Params:
         """Close the async buffer: global += weighted-mean folded delta
         (the finalize divides by the folded staleness-discounted
-        weights). A no-op when nothing folded since the last publish."""
+        weights). A no-op when nothing folded since the last publish.
+        Weak-DP noise (if configured) lands on each published global,
+        keyed by run seed + publish index."""
         if self.pending_folds() == 0:
             return self.global_params
         mean_delta = self._acc.finalize()
         self.global_params = jax.tree.map(
             lambda g, d: g + d.astype(g.dtype), self.global_params, mean_delta
         )
+        self.global_params = self._apply_weak_dp(self.global_params)
         self._agg_round += 1
         self._reset_window()
         return self.global_params
@@ -307,55 +534,59 @@ class FedMLAggregator:
         total weight).
 
         Streaming: the round's work already happened upload-by-upload;
-        this is an O(model) finalize. Buffered: the sorted buffer runs
-        through the SAME fold, so the two modes are bit-identical.
-        Full-cohort fallback (defense/custom aggregator): the legacy
-        stacked reduction."""
+        this is an O(model) finalize (plus weak-DP noise when
+        configured — clipping already happened per term). Buffered: the
+        sorted buffer runs through the SAME fold — including the SAME
+        clipped executables for clipping defenses — so the two modes
+        are bit-identical. Full-cohort fallback (median/custom
+        aggregator): the legacy stacked reduction."""
         if not self._folded:
             raise RuntimeError("aggregate() with no received models")
         if self.streaming:
-            self.global_params = self._acc.finalize()
+            self.global_params = self._apply_weak_dp(self._acc.finalize())
         elif self._fallback_reason is not None:
             idxs_trees = self._reconstructed_pending()
             trees = [t for _, t, _ in idxs_trees]
             ns = jnp.asarray([w for _, _, w in idxs_trees])
             stacked = stack_pytrees(trees)
             weights = normalize_weights(ns)
+            rng = derive_defense_rng(
+                getattr(self.args, "random_seed", 0), self._agg_round
+            )
             if self.server_aggregator is not None:
                 # L3 operator seam (core/frame.py): custom pure reduction
-                rng = jax.random.fold_in(
-                    jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
-                    self._agg_round,
-                )
                 self.global_params = self.server_aggregator.aggregate(
                     self.global_params, stacked, weights, rng
                 )
             else:
-                from ...core.aggregation import RobustAggregator
-
-                self.global_params = RobustAggregator(self.args).aggregate(
-                    stacked, weights, self.global_params,
-                    rng=jax.random.fold_in(
-                        jax.random.PRNGKey(
-                            int(getattr(self.args, "random_seed", 0))
-                        ),
-                        self._agg_round,
-                    ),
+                self.global_params = self._robust.aggregate(
+                    stacked, weights, self.global_params, rng=rng
                 )
         else:
             # buffered baseline: identical math to streaming, applied
             # in sorted index order at close (order is immaterial — the
             # fold is order-independent — but sorted keeps it obvious)
             acc = StreamingAccumulator(self.global_params)
+            bound = self._robust.norm_bound if self._clip_streaming else None
             for i in sorted(self._pending):
                 kind, payload, w = self._pending[i]
-                if kind == "enc":
+                if bound is not None:
+                    if kind == "enc":
+                        _, clipped = acc.fold_encoded_clipped(
+                            self._codec, payload, self.global_params, bound, w
+                        )
+                    else:
+                        _, clipped = acc.fold_clipped(
+                            payload, self.global_params, bound, w
+                        )
+                    self._note_clipped(clipped)
+                elif kind == "enc":
                     acc.fold_encoded(self._codec, payload, self.global_params, w)
                 else:
                     acc.fold(payload, w)
                 self.folds_total += 1
                 self._tel.inc("agg_folds_total", mode=self.agg_mode)
-            self.global_params = acc.finalize()
+            self.global_params = self._apply_weak_dp(acc.finalize())
         self._agg_round += 1
         self._reset_window()
         return self.global_params
@@ -365,6 +596,7 @@ class FedMLAggregator:
         the async publish path)."""
         if self._acc is not None:
             self._acc.reset()
+        self._screen_ref = None
         self._pending.clear()
         self._folded.clear()
         self.sample_num_dict.clear()

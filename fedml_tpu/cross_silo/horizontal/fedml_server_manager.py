@@ -71,6 +71,20 @@ publish; a restarted server seeds its dedup ledger from it, so a
 retransmitted pre-crash upload can neither double-fold nor be
 silently half-applied.
 
+**Beyond the reference — Byzantine defense on every path**
+(docs/robustness.md threat model): ``norm_diff_clipping`` / ``weak_dp``
+ride the streaming fold itself (clip fused into the per-term jit,
+noise at finalize — the aggregator's job), and this manager wires the
+quarantine half: an upload the anomaly screen rejects drops its
+rank's slot through the SAME drop-expected path a failure-detector
+death uses (the quorum denominator shrinks — a quarantined rank never
+stalls ``round_grace_s``), quarantined ranks are excluded from
+subsequent broadcasts/dispatches until their probation expires (ticked
+per round close in sync modes, per publish in async, where released
+ranks are re-dispatched immediately), and an async federation whose
+every online rank is quarantined finishes loudly instead of waiting
+for a fold that can never arrive.
+
 **Beyond the reference — crash recovery**: with ``checkpoint_dir`` the
 server keeps a ``RoundWAL`` (round idx + checkpoint step + sampled
 cohort + folded set per completed round) next to its orbax
@@ -547,12 +561,53 @@ class FedMLServerManager(ServerManager):
             self._async_publish()
             self.send_finish()
             self.finish()
+            return
+        # the death may have left only QUARANTINED ranks online — no
+        # fold (and therefore no publish, no probation tick) can ever
+        # arrive, so the stall check must run here too
+        self._async_check_quarantine_stall()
+
+    def _async_check_quarantine_stall(self) -> None:
+        """Async liveness under quarantine: folds are the only progress
+        signal, and probation ticks ride publishes (which ride folds).
+        If every online rank is quarantined and nothing is outstanding,
+        no fold can ever arrive — finish loudly instead of hanging (the
+        sync path has no analog: its rounds close via drop_expected)."""
+        online = set(self._active_ranks())
+        quarantined = self.aggregator.quarantined_ranks()
+        if (
+            self.is_initialized
+            and online
+            and not (online - quarantined)
+            and not self._outstanding
+        ):
+            logging.error(
+                "async: every online client is quarantined (%s) with no "
+                "work outstanding (%d/%d folds done); finishing",
+                sorted(quarantined), self.async_folds,
+                self._async_target_folds(),
+            )
+            # flush accepted-but-unpublished folds, then record the
+            # terminal eval like the fold-target done path does. The
+            # publish's probation tick may hand a just-released rank
+            # one dispatch the FINISH right behind it abandons — a
+            # wasted local round, never wrong state.
+            self._async_publish()
+            self.aggregator.test_on_server_for_all_clients(self.version)
+            self.send_finish()
+            self.finish()
 
     def _maybe_resync(self, rank: int) -> None:
         """Ship the CURRENT round + params + pending assignment to a
         rank that (re)appeared mid-round — a restarted client resumes
         the round instead of stalling it until detector/deadline."""
         if self.agg_mode == "async":
+            if self.aggregator.screen.is_quarantined(rank - 1):
+                # no fresh work for a quarantined rank; if it is now
+                # the ONLY rank left, the federation must finish loudly
+                # rather than wait for a fold that cannot come
+                self._async_check_quarantine_stall()
+                return
             # async reconnect: hand the rank fresh work at the current
             # version (a fresh seq supersedes any pre-crash dispatch,
             # so its in-flight upload — if any — discards cleanly)
@@ -629,10 +684,19 @@ class FedMLServerManager(ServerManager):
         (fedml_server_manager.py:47-69 and :167-207): pick which edge
         ranks participate (``client_selection``), map them onto data-silo
         indices (``data_silo_selection``), send the global model."""
+        # quarantined ranks sit out entire cohorts until their
+        # probation expires (docs/robustness.md quarantine lifecycle) —
+        # excluded here exactly like detector-declared-dead ranks
+        quarantined = self.aggregator.quarantined_ranks()
+        self.telemetry.set_gauge("defense_quarantined_now", len(quarantined))
         if self.elastic:
             # membership is whoever is online right now; selection caps
             # at client_num_per_round of them
-            candidate_ids = [self.client_real_ids[r - 1] for r in self._active_ranks()]
+            candidate_ids = [
+                self.client_real_ids[r - 1]
+                for r in self._active_ranks()
+                if r not in quarantined
+            ]
             n_select = min(
                 int(self.args.client_num_per_round), len(candidate_ids)
             )
@@ -644,6 +708,7 @@ class FedMLServerManager(ServerManager):
                 rid
                 for rid in self.client_real_ids
                 if self._rank_of_real_id[rid] not in self._dead_ranks
+                and self._rank_of_real_id[rid] not in quarantined
             ]
             n_select = len(candidate_ids)
         selected_real_ids = self.aggregator.client_selection(
@@ -857,12 +922,31 @@ class FedMLServerManager(ServerManager):
         # accumulator RIGHT NOW — the straggler-wait window does the
         # aggregation work, and quantized payloads decode inside the
         # fold's fused jit. Buffered/fallback: stored until close.
-        self.aggregator.receive_upload(
+        status = self.aggregator.receive_upload(
             sender_rank - 1,
             local_sample_num,
             model_params=model_params,
             encoded=encoded,
         )
+        if status == "quarantined":
+            # the anomaly screen rejected this upload BEFORE folding.
+            # The rank must not stall the round either: drop its
+            # pending slot exactly like a failure-detector death, so
+            # the quorum denominator shrinks and the grace timer can
+            # arm/close over the survivors.
+            logging.warning(
+                "round %d: upload from quarantined rank %d rejected; "
+                "dropping its slot from the round",
+                self.round_idx, sender_rank,
+            )
+            if self.is_initialized and self.aggregator.drop_expected(
+                sender_rank - 1
+            ):
+                if self.aggregator.check_whether_all_receive():
+                    self._finish_round()
+                    return
+                self._maybe_arm_quorum()
+            return
         if not self._wait_open:
             self.profiler.log_event_started("server.wait")
             self._wait_open = True
@@ -1105,9 +1189,21 @@ class FedMLServerManager(ServerManager):
             )
         else:
             scale = float(self.staleness_decay) ** staleness
-            self.aggregator.fold_delta(
-                n, delta=raw, encoded=enc, weight_scale=scale
+            status = self.aggregator.fold_delta(
+                n, delta=raw, encoded=enc, weight_scale=scale,
+                index=sender_rank - 1, staleness=staleness,
             )
+            if status == "quarantined":
+                # rejected before folding; no fresh work until the
+                # probation (ticked per publish) releases the rank —
+                # _async_publish redispatches released ranks
+                logging.warning(
+                    "async: upload from quarantined rank %d rejected "
+                    "(seq %d); rank sits out until probation expires",
+                    sender_rank, seq,
+                )
+                self._async_check_quarantine_stall()
+                return
             self._folded_ids.add((sender_rank, seq))
             self._folded_since_publish.append((sender_rank, seq))
             self.async_folds += 1
@@ -1193,6 +1289,17 @@ class FedMLServerManager(ServerManager):
                 ckpt_due = False
         if ckpt_due:
             self._save_checkpoint()
+        # async probation ticks per publish; a released rank gets fresh
+        # work immediately (nothing else would re-engage it — async has
+        # no per-round broadcast to pick it back up)
+        for idx in self.aggregator.tick_defense():
+            rank = idx + 1
+            if self.client_online_status.get(rank, False):
+                self._async_dispatch(rank)
+        self.telemetry.set_gauge(
+            "defense_quarantined_now",
+            len(self.aggregator.quarantined_ranks()),
+        )
         self.telemetry.inc("agg_publish_total")
         self.telemetry.heartbeat("cross_silo.round", self.version)
         self.telemetry.inc("cross_silo_rounds_total")
@@ -1237,6 +1344,14 @@ class FedMLServerManager(ServerManager):
             logging.warning(
                 "round %d: no contributions (all expected clients left); "
                 "global model unchanged", self.round_idx,
+            )
+        # one quarantine-probation period per round close; released
+        # ranks re-enter candidate selection at the next broadcast
+        released = self.aggregator.tick_defense()
+        if released:
+            logging.info(
+                "round %d: quarantine probation expired for rank(s) %s",
+                self.round_idx, [i + 1 for i in released],
             )
         self._record_round_segments(
             self.round_idx, _time.perf_counter() - t_agg0

@@ -339,6 +339,97 @@ def _device_synth_classification(
     )
 
 
+def _resolve_poisoned_idxs(args, client_num: int, seed: int):
+    """Which client indexes are attackers: an explicit
+    ``poisoned_client_idxs`` list wins; else ``poisoned_client_fraction``
+    of the federation, drawn with a seed-derived RandomState (the
+    fedavg_robust convention — attacker identity is part of the
+    experiment config, never of the run's training randomness)."""
+    idxs = getattr(args, "poisoned_client_idxs", None)
+    if idxs:
+        # USER ORDER preserved: a poison_type LIST pairs with these
+        # 1:1 positionally, so sorting/deduping here would silently
+        # swap attacks between clients
+        out = [int(i) for i in idxs]
+        if len(set(out)) != len(out):
+            raise ValueError(
+                f"poisoned_client_idxs {out} contains duplicates"
+            )
+        bad = [i for i in out if not 0 <= i < client_num]
+        if bad:
+            raise ValueError(
+                f"poisoned_client_idxs {bad} out of range for "
+                f"{client_num} clients"
+            )
+        return out
+    frac = float(getattr(args, "poisoned_client_fraction", 0.0) or 0.0)
+    if frac <= 0:
+        return []
+    k = min(client_num, max(1, int(round(frac * client_num))))
+    return sorted(
+        np.random.RandomState(seed + 77)
+        .choice(client_num, k, replace=False)
+        .tolist()
+    )
+
+
+def _maybe_poison_clients(args, xs_tr, ys_tr, class_num: int, seed: int, task: str):
+    """Poisoned-world wiring (``args.poison_type`` — the reference
+    fork's fedavg_robust experiment shape): apply ``data/poison.py``
+    attacks to the configured attacker clients' TRAIN shards before
+    packing. ``poison_type`` is one type for every attacker or a list
+    paired with ``poisoned_client_idxs`` (mixed-attack worlds, e.g.
+    label_flip + backdoor_pattern). Loud by design: a poisoned world
+    always logs who is poisoned with what."""
+    ptype = getattr(args, "poison_type", None) or None
+    if ptype is None:
+        return xs_tr, ys_tr
+    if task != "classification":
+        raise ValueError(
+            f"poison_type={ptype!r} supports classification datasets "
+            f"only (got task={task!r})"
+        )
+    target = int(getattr(args, "target_label", 0) or 0)
+    if not 0 <= target < class_num:
+        # an out-of-head target would one_hot to an all-zero row and
+        # train the attackers on garbage SILENTLY — a different
+        # experiment than the config claims
+        raise ValueError(
+            f"target_label={target} out of range for {class_num} classes"
+        )
+    from .poison import poison_clients
+
+    if isinstance(ptype, (list, tuple)) and not getattr(
+        args, "poisoned_client_idxs", None
+    ):
+        # a list pairs 1:1 positionally; zipping it against a
+        # fraction-drawn (seed-dependent, sorted) attacker set would
+        # assign attacks to arbitrary clients silently
+        raise ValueError(
+            "poison_type as a list pairs 1:1 with poisoned_client_idxs; "
+            "set the idxs explicitly (poisoned_client_fraction draws an "
+            "arbitrary attacker set)"
+        )
+    client_num = len(xs_tr)
+    idxs = _resolve_poisoned_idxs(args, client_num, seed)
+    if not idxs:
+        raise ValueError(
+            "poison_type is set but no attacker clients are configured; "
+            "set poisoned_client_idxs or poisoned_client_fraction"
+        )
+    xs_tr, ys_tr, _ = poison_clients(
+        xs_tr, ys_tr, ptype, class_num, idxs,
+        target_label=target,
+        fraction=float(getattr(args, "poison_sample_fraction", 1.0) or 1.0),
+        data_cache_dir=getattr(args, "data_cache_dir", None),
+    )
+    logging.warning(
+        "POISONED WORLD: clients %s carry %s (target_label=%s)",
+        idxs, ptype, target,
+    )
+    return xs_tr, ys_tr
+
+
 def _widen_class_num(name: str, class_num: int, observed: int) -> int:
     """Custom/truncated on-disk copies may carry ids beyond the
     canonical class count; widen the head rather than training silently
@@ -405,6 +496,15 @@ def load(args) -> FederatedDataset:
 
         vfl_dir = os.path.join(cache, name)
         if vfl_party_csvs_available(vfl_dir):
+            if getattr(args, "poison_type", None):
+                # loud-by-design: the data/poison.py attacks mutate
+                # horizontal per-client label/feature shards, which a
+                # vertical party split does not have — ignoring the
+                # knob would claim a poisoned world and train clean
+                raise ValueError(
+                    f"poison_type={args.poison_type!r} is not supported "
+                    f"for VFL party-CSV datasets (found {vfl_dir!r})"
+                )
             return _load_vfl_dataset(args, vfl_dir, client_num, batch_size, seed)
 
     if name.startswith("synthetic"):
@@ -457,7 +557,16 @@ def load(args) -> FederatedDataset:
             )
             class_num = _widen_class_num(name, class_num, observed)
     else:
-        dev_ds = _device_synth_classification(args, name, client_num, batch_size, seed)
+        # a poisoned world needs host-side feature arrays (trigger
+        # stamps / edge-case injection mutate x), so the zero-transfer
+        # device-synth shortcut does not apply
+        dev_ds = (
+            None
+            if getattr(args, "poison_type", None)
+            else _device_synth_classification(
+                args, name, client_num, batch_size, seed
+            )
+        )
         if dev_ds is not None:
             return dev_ds
         x_tr, y_tr, x_te, y_te, class_num, task = _raw_data(args)
@@ -513,6 +622,11 @@ def load(args) -> FederatedDataset:
         te_map = homo_partition(len(y_te), client_num, seed + 1)
         xs_te = [x_te[te_map[i]] for i in range(client_num)]
         ys_te = [y_te[te_map[i]] for i in range(client_num)]
+
+    # poisoning applies AFTER partitioning (attacks are per-client) and
+    # BEFORE packing, so every downstream view — packed federation,
+    # global eval set slices, local dicts — sees the attacker's data
+    xs_tr, ys_tr = _maybe_poison_clients(args, xs_tr, ys_tr, class_num, seed, task)
 
     import jax.numpy as jnp
 

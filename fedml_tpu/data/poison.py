@@ -36,7 +36,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-POISON_TYPES = ("label_flip", "targeted_flip", "backdoor_pattern", "edge_case")
+from .. import constants
+
+# ONE authoritative vocabulary (constants.POISON_TYPES) shared with the
+# knob validation in arguments.py and the loader's poisoned-world
+# wiring; re-exported here for compatibility
+POISON_TYPES = constants.POISON_TYPES
 
 # archive-relative candidates per edge-case kind (reference
 # data_loader.py:393-488 file names): southwest airplanes are
@@ -180,17 +185,32 @@ def poison_dataset(
 def poison_clients(
     xs: List[np.ndarray],
     ys: List[np.ndarray],
-    poison_type: str,
+    poison_type,
     num_classes: int,
     poisoned_client_idxs: Sequence[int],
     **kw,
 ) -> Tuple[List[np.ndarray], List[np.ndarray], List[int]]:
     """Poison the listed clients in-place-by-copy; returns
-    (xs, ys, poisoned idxs)."""
+    (xs, ys, poisoned idxs). ``poison_type`` is one type for every
+    client or a sequence paired 1:1 with ``poisoned_client_idxs`` (in
+    the CALLER's order — mixed-attack worlds). This is THE per-client
+    seed convention (1000 + client idx); the loader's poisoned-world
+    wiring routes through here."""
     xs, ys = list(xs), list(ys)
-    for i in poisoned_client_idxs:
+    types = (
+        list(poison_type)
+        if isinstance(poison_type, (list, tuple))
+        else [poison_type] * len(poisoned_client_idxs)
+    )
+    if len(types) != len(poisoned_client_idxs):
+        raise ValueError(
+            f"poison_type list has {len(types)} entries for "
+            f"{len(poisoned_client_idxs)} poisoned clients — pair them "
+            "1:1 (or pass one type)"
+        )
+    for i, t in zip(poisoned_client_idxs, types):
         xs[i], ys[i] = poison_dataset(
-            xs[i], ys[i], poison_type, num_classes, seed=1000 + i, **kw
+            xs[i], ys[i], t, num_classes, seed=1000 + i, **kw
         )
     return xs, ys, list(poisoned_client_idxs)
 

@@ -143,10 +143,12 @@ SUPPLEMENT = {
     "gpu_mapping_file": "reference-parity cluster mapping file (unused on TPU)",
     "grpc_ipconfig_path": "CSV of rank->ip for the gRPC fabric",
     "grpc_port_base": "first gRPC port (rank k listens on base+k)",
-    "defense_type": "robust aggregation: `norm_clip` | `weak_dp` | "
-                    "`coord_median` (core/aggregation.py)",
-    "norm_bound": "update norm clip bound (norm_clip / weak_dp)",
-    "stddev": "weak-DP noise stddev",
+    "defense_type": "robust aggregation: `norm_diff_clipping` | `weak_dp` "
+                    "(both stream per-upload) | `median` (buffered); "
+                    "unknown strings rejected loudly (core/aggregation.py)",
+    "norm_bound": "norm-diff clip radius (norm_diff_clipping / weak_dp)",
+    "stddev": "weak-DP noise stddev, added at finalize with a "
+              "run-seed+round derived key",
     "matmul_precision": "jax matmul precision (`highest` for oracle "
                         "equivalence tests; `default` for speed)",
     "mesh_shape": "mesh axes -> sizes; simulation MESH: `{clients, data}`; "
@@ -184,7 +186,12 @@ GROUPS = [
         "grpc_send_timeout_s", "heartbeat_interval_s", "heartbeat_timeout_s",
         "round_deadline_s",
     ]),
-    ("Defense", ["defense_type", "norm_bound", "stddev"]),
+    ("Defense & attack synthesis", [
+        "defense_type", "norm_bound", "stddev",
+        "defense_anomaly_threshold", "defense_quarantine_rounds",
+        "poison_type", "poisoned_client_idxs", "poisoned_client_fraction",
+        "target_label", "poison_sample_fraction",
+    ]),
     ("Parallelism (mesh / distributed)", [
         "mesh_shape", "sp_strategy", "sp_ring_block", "pp_microbatches",
         "moe_aux_weight", "grad_accum_steps", "matmul_precision",

@@ -110,6 +110,7 @@ PHASES = [
     ("telemetry", ["--phase", "telemetry"], 300.0),
     ("serving", ["--phase", "serving"], 300.0),
     ("tracing", ["--phase", "tracing"], 300.0),
+    ("defense", ["--phase", "defense"], 420.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

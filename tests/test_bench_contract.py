@@ -109,6 +109,20 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"straggler"' in parent or "'straggler'" in parent
 
+    def test_defense_phase_contract(self):
+        """detail.defense ships the Byzantine-robustness evidence
+        (clipping bit-identical stream vs buffered with zero loud
+        fallbacks, undefended-poisoned divergence vs defended recovery,
+        attacker quarantine, async staleness-aware defenses,
+        exactly-once fold accounting): the phase is in the child
+        vocabulary and the parent stitches it (like straggler, it runs
+        demoted on the CPU fallback)."""
+        assert "defense" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"defense"' in parent or "'defense'" in parent
+
     def test_tracing_phase_contract(self):
         """detail.tracing ships the distributed-tracing evidence
         (matched cross-process flows, critical-path segment sums,
@@ -269,6 +283,49 @@ class TestPhaseChild:
         assert a["exactly_once"] is True
         assert a["stale_folds"] >= 1
         assert a["staleness_weights_match_oracle"] is True
+
+    @pytest.mark.slow  # ~60s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's defense smoke block
+    def test_defense_smoke_child_writes_valid_json(self):
+        """The CI defense smoke invocation (6 clients x 6 rounds,
+        poisoned worlds, CPU): Byzantine robustness runs end-to-end
+        through bench.py's defense phase child — clip bit-identity,
+        undefended divergence, defended recovery with quarantine under
+        drop/dup faults, async staleness-aware defenses — and emits the
+        detail.defense contract keys."""
+        d = self._run_child("defense", 500, smoke=True)
+        # streamable clipping: bit-identity at O(model) memory, no
+        # loud buffered fallback for a clipping config
+        assert d["clip_stream_identical_to_buffered"] is True
+        assert d["max_abs_diff_clip_stream_vs_buffered"] == 0.0
+        assert d["clip_stream_fallbacks"] == 0
+        assert d["clip_stream_peak_buffered"] == 0
+        assert d["clip_buffered_peak_buffered"] == d["clients"]
+        assert d["clipped_uploads"] > 0
+        # the poisoned world hurts without a defense...
+        assert d["undefended_diverges"] is True
+        assert d["undefended_loss"] > 3.0 * d["clean_loss"]
+        # ...and the defended world recovers: attacker ranks
+        # quarantined, rounds keep completing through the
+        # drop-expected path, model back within bound of clean
+        assert d["attackers_quarantined"] is True
+        assert set(d["attacker_ranks"]) <= set(d["quarantined_ranks"])
+        assert d["rounds_completed"] == d["rounds"]
+        assert d["defended_within_bound"] is True
+        assert d["defended_loss"] < 0.5 * d["undefended_loss"]
+        assert d["defense_clipped_total"] > 0
+        assert d["quarantine_rejected_uploads"] >= 1
+        # exactly-once accounting survives dup faults + quarantine
+        assert d["exactly_once"] is True
+        assert d["folds_total"] == d["uploads_aggregated"]
+        # async: the construction-time rejection is gone — defenses
+        # run per fold, the attacker is quarantined, folds hit target
+        a = d["async"]
+        assert a["attacker_quarantined"] is True
+        assert a["folds_total"] >= a["target_folds"]
+        assert a["clipped_uploads"] > 0
+        assert a["quarantine_rejected_uploads"] >= 1
+        assert a["defended_within_bound"] is True
 
     @pytest.mark.slow  # ~90s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's tracing smoke block

@@ -549,6 +549,14 @@ class TestStreamingFallback:
         assert needs_full_cohort(a, None) is None
         a.defense_type = "median"
         assert "median" in needs_full_cohort(a, None)
+        # clipping defenses moved INTO the fold (PR 8): they stream
+        for streamable in ("norm_diff_clipping", "weak_dp"):
+            a.defense_type = streamable
+            assert needs_full_cohort(a, None) is None
+        # an unknown string is a loud error, never a silent plain mean
+        a.defense_type = "norm_clip"
+        with pytest.raises(ValueError, match="unknown defense_type"):
+            needs_full_cohort(a, None)
         a.defense_type = None
         assert "ServerAggregator" in needs_full_cohort(
             a, DefaultServerAggregator(None)
