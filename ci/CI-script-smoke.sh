@@ -80,3 +80,14 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_defense_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Planet smoke (100k-client registry, 1k cohort x 3 rounds, CPU): the
+# planet-scale population plane must run end-to-end through bench.py's
+# planet phase child and emit the detail.planet contract keys —
+# registry-backed rounds completing, warm-run peak-RSS delta flat in
+# registry size (scales with the cohort), two-tier edge-tree
+# aggregation bit-identical to the flat fold of the same terms, and
+# the jit-trace census within the pow2 bucket budget.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_planet_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -195,6 +195,28 @@ _DEFAULTS: Dict[str, Any] = {
     "target_label": 0,
     # fraction of each attacker's samples that are poisoned
     "poison_sample_fraction": 1.0,
+    # planet-scale population plane (fedml_tpu/scale/): register this
+    # many clients as columnar state (~17 bytes each) and draw cohorts
+    # from the registry with O(cohort) memory per round, datasets
+    # materialized on demand. 0 = off (eager federation, the default).
+    # Simulation-only; requires a classification task and the stock
+    # FedAvg/FedProx server step
+    "client_registry_size": 0,
+    # registry-mode cohort drawn per round (0 = client_num_per_round)
+    "cohort_size": 0,
+    # two-tier aggregation tree (fedml_tpu/scale/tree.py): this many
+    # edge aggregators each fold their subtree through the streaming
+    # accumulator and the root folds the edge partials — bit-identical
+    # to flat aggregation. Applies to the registry-backed simulator AND
+    # the cross-silo streaming server (agg_mode=stream). 0/1 = flat
+    "edge_num": 0,
+    # back the registry columns with .npy memmaps under this directory
+    # instead of host RAM (None = in-RAM numpy)
+    "registry_dir": None,
+    # A/B bit-identity harness (detail.planet bench): partition terms
+    # per edge exactly as the tree would, but fold them into ONE flat
+    # accumulator — the baseline the tree identity is asserted against
+    "edge_flat_fold": False,
     # precision: the 3-decimal equivalence oracles need f32 matmuls
     "matmul_precision": "highest",
     # mixed precision (core/local_trainer.py): "bfloat16" runs the
@@ -618,6 +640,39 @@ class Arguments:
                 f"profile_rounds={pr!r}: pass a list of round indices or "
                 "a comma-separated string"
             )
+        # -- planet-scale population plane (fedml_tpu/scale/) ----------
+        for int_key in ("client_registry_size", "cohort_size", "edge_num"):
+            raw = getattr(self, int_key)
+            try:
+                setattr(self, int_key, int(raw or 0))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{int_key}={raw!r}: must be an integer"
+                ) from None
+            if getattr(self, int_key) < 0:
+                raise ValueError(
+                    f"{int_key}={getattr(self, int_key)}: must be >= 0 "
+                    "(0 disables)"
+                )
+        if self.client_registry_size > 0:
+            if t != constants.FEDML_TRAINING_PLATFORM_SIMULATION:
+                raise ValueError(
+                    "client_registry_size applies to training_type="
+                    "simulation only (the cross-silo edge tier is the "
+                    f"edge_num knob); got training_type={t!r}"
+                )
+            cohort = self.cohort_size or self.client_num_per_round
+            if cohort > self.client_registry_size:
+                raise ValueError(
+                    f"cohort_size={cohort} exceeds "
+                    f"client_registry_size={self.client_registry_size}"
+                )
+            if self.edge_num > cohort:
+                raise ValueError(
+                    f"edge_num={self.edge_num} exceeds the cohort size "
+                    f"{cohort}: an edge tier wider than its cohort is a "
+                    "misconfiguration, not a topology"
+                )
 
     # -- niceties ------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:

@@ -350,6 +350,15 @@ class StreamingAccumulator:
     def fold(self, theta: Params, w: float) -> None:
         self._fold_term(_weighted_term(theta, jnp.float32(w)), w)
 
+    def fold_weighted_term(self, term: Params, w: float) -> None:
+        """Fold an ALREADY-WEIGHTED partial sum ``term = sum_i w_i *
+        theta_i`` carrying total weight ``w = sum_i w_i`` — the
+        registry-backed simulator's client->edge hop, where a whole
+        vmap group's per-edge partial is computed in one fused jitted
+        reduction (term rounding happens there, once, deterministically
+        per group) and lands in the tree as a single fold."""
+        self._fold_term(term, w)
+
     def fold_encoded(self, codec, encoded: Params, like: Params, w: float) -> None:
         """Fold a compressed upload: decode + reconstruct + weight in
         one fused jitted step against the pre-round global tree."""
@@ -418,6 +427,22 @@ class StreamingAccumulator:
         if self.count == 0:
             return None
         return _tree_scaled(self._limbs[0], jnp.float32(self.total_w))
+
+    def merge(self, other: "StreamingAccumulator") -> None:
+        """Fold another accumulator's state into this one — the edge ->
+        root hop of a two-tier aggregation tree (``fedml_tpu/scale/
+        tree.py``). Each of the other's three limbs is folded as a term
+        through the SAME add-only exact-expansion jit, so the merged
+        expansion represents the union's sum to the usual ~2^-48 lowest-
+        limb error and the float32 finalize stays bitwise independent
+        of how uploads were partitioned across accumulators (tree ==
+        flat, asserted in tests and the ``detail.planet`` bench).
+        ``total_w``/``count`` add exactly (python floats over integer
+        sample counts)."""
+        for limb in other._limbs:
+            self._limbs = _fold_tree(self._limbs, limb)
+        self.total_w += other.total_w
+        self.count += other.count
 
     def _fold_term(self, term: Params, w: float) -> None:
         self._limbs = _fold_tree(self._limbs, term)

@@ -6,13 +6,16 @@ memory constraints, minimizing makespan — the "Parrot" scheduling seed
 (SURVEY.md §2.6). ``DP_schedule(mode)`` produces per-resource job
 "bunches" (scheduler.py:110-172).
 
-Like the reference's scheduler, this is a standalone service (SURVEY.md
-§2.6: "not yet wired into the round loop"): under the current padded
-packing every client trains the same number of (masked) batches, so
-shard assignment cannot change the makespan and the mesh simulator does
-not consume it. ``balance_clients_across_shards`` is the consumer-ready
-seam for when packing becomes per-shard-bucketed (different nb per shard
-group); today it is exercised by tests only.
+Wired into the round loop via the planet-scale population plane
+(``fedml_tpu/scale/cohort.py``): registry-backed cohort packing calls
+``greedy_makespan`` to LPT-split oversized nb-buckets on
+heterogeneity-aware workloads (samples x ``2**speed_tier``) and
+``balance_clients_across_shards`` to deal each group's clients across
+mesh lanes; ``fedml_tpu/scale/tree.py`` reuses the boustrophedon deal
+for load-balanced client->edge assignment. Under classic eager packing
+every client trains the same number of (masked) batches, so those
+paths still do not consume it — the seam's consumer is the per-group
+bucketed packer.
 """
 
 from __future__ import annotations

@@ -23,6 +23,40 @@ except ImportError:  # pragma: no cover
     _HAS_PSUTIL = False
 
 
+def current_rss_bytes() -> int:
+    """This process's resident set size right now (0 only when
+    unmeasurable: no psutil AND no /proc). The planet-scale bench
+    differences this around a round to measure the
+    O(cohort)-not-O(registry) host-memory claim — and fails its gate
+    loudly on 0 rather than passing vacuously."""
+    if _HAS_PSUTIL:
+        return int(psutil.Process().memory_info().rss)
+    try:  # psutil-less Linux: statm field 2 is resident page count
+        import os
+
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):  # pragma: no cover
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """Lifetime peak resident set size of this process (ru_maxrss).
+    Exported by the ``detail.planet`` bench as the
+    ``planet_peak_rss_bytes`` gauge — flat-memory claims are measured,
+    not asserted in prose."""
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except (ImportError, ValueError):  # pragma: no cover — non-POSIX
+        return current_rss_bytes()
+    # linux reports KiB, macOS bytes
+    import sys
+
+    return int(peak if sys.platform == "darwin" else peak * 1024)
+
+
 def sample_host_stats() -> Dict[str, Any]:
     """One snapshot of host CPU/memory/disk/net counters."""
     if not _HAS_PSUTIL:

@@ -141,6 +141,19 @@ class FedMLAggregator:
             )
             self._tel.inc("agg_stream_fallback_total")
         self._acc: Optional[StreamingAccumulator] = None
+        # two-tier edge tier (fedml_tpu/scale/tree.py): with
+        # edge_num >= 2 each rank's upload folds into its edge's
+        # accumulator and aggregate() finalizes through the root merge
+        # — bit-identical to the flat fold (the tree's contract), so an
+        # edge tier slides under a live federation without changing a
+        # result bit. Sync streaming only: async folds deltas against a
+        # moving global and keeps the flat accumulator.
+        edge_num = int(getattr(args, "edge_num", 0) or 0)
+        self._tree = None
+        if edge_num >= 2 and self.streaming and self.agg_mode == "stream":
+            from ...scale.tree import EdgeAggregationTree
+
+            self._tree = EdgeAggregationTree(self.global_params, edge_num)
         # encoded/raw payloads awaiting a buffered aggregate; streaming
         # never populates it (that is the whole point)
         self._pending: Dict[int, Tuple[str, Params, float]] = {}
@@ -154,10 +167,22 @@ class FedMLAggregator:
     def set_global_model_params(self, params: Params) -> None:
         self.global_params = params
 
-    def _accumulator(self) -> StreamingAccumulator:
+    def _accumulator(self, index: int = 0) -> StreamingAccumulator:
+        """The accumulator upload ``index`` folds into: the rank's edge
+        accumulator when the edge tier is active, else the single flat
+        one (async always flat — see ``__init__``)."""
+        if self._tree is not None:
+            return self._tree.acc_for(index)
         if self._acc is None:
             self._acc = StreamingAccumulator(self.global_params)
         return self._acc
+
+    def _running_mean(self) -> Optional[Params]:
+        """Streaming running aggregate for the anomaly screen, across
+        whichever fold topology is active."""
+        if self._tree is not None:
+            return self._tree.running_mean()
+        return self._acc.running_mean() if self._acc is not None else None
 
     def receive_upload(
         self,
@@ -214,18 +239,18 @@ class FedMLAggregator:
                 # stays bitwise — the close folds the same executables)
                 bound = self._robust.norm_bound
                 if model_params is not None:
-                    _, clipped = self._accumulator().fold_clipped(
+                    _, clipped = self._accumulator(index).fold_clipped(
                         payload, self.global_params, bound, w
                     )
                 else:
-                    _, clipped = self._accumulator().fold_encoded_clipped(
+                    _, clipped = self._accumulator(index).fold_encoded_clipped(
                         self._codec, payload, self.global_params, bound, w
                     )
                 self._note_clipped(clipped)
             elif model_params is not None:
-                self._accumulator().fold(payload, w)
+                self._accumulator(index).fold(payload, w)
             else:
-                self._accumulator().fold_encoded(
+                self._accumulator(index).fold_encoded(
                     self._codec, payload, self.global_params, w
                 )
             self.folds_total += 1
@@ -294,11 +319,7 @@ class FedMLAggregator:
                 if raw
                 else decoded_delta(self._codec, payload, self.global_params)
             )
-            rm = (
-                self._acc.running_mean()
-                if (self.streaming and self._acc is not None)
-                else None
-            )
+            rm = self._running_mean() if self.streaming else None
             # sync running aggregate is a mean MODEL; compare deltas.
             # Buffered/fallback: the screening-only running delta sum
             # (no accumulator exists until close)
@@ -543,7 +564,8 @@ class FedMLAggregator:
         if not self._folded:
             raise RuntimeError("aggregate() with no received models")
         if self.streaming:
-            self.global_params = self._apply_weak_dp(self._acc.finalize())
+            acc = self._tree if self._tree is not None else self._acc
+            self.global_params = self._apply_weak_dp(acc.finalize())
         elif self._fallback_reason is not None:
             idxs_trees = self._reconstructed_pending()
             trees = [t for _, t, _ in idxs_trees]
@@ -596,6 +618,8 @@ class FedMLAggregator:
         the async publish path)."""
         if self._acc is not None:
             self._acc.reset()
+        if self._tree is not None:
+            self._tree.reset()
         self._screen_ref = None
         self._pending.clear()
         self._folded.clear()

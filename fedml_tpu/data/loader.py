@@ -481,9 +481,85 @@ def _raw_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int
     return x_tr, y_tr, x_te, y_te, class_num, task
 
 
+def _registry_dataset(args) -> FederatedDataset:
+    """Slim dataset for the planet-scale registry path
+    (``fedml_tpu/scale/``): the population is NOT materialized here —
+    no per-client arrays, no packed federation, no local dicts
+    proportional to ``client_registry_size``. Cohort data is generated
+    on demand by the registry each round; this object carries only the
+    task geometry (class count, feature shape via the eval packs) and
+    fixed-size global eval holdouts."""
+    name = getattr(args, "dataset", "synthetic").lower()
+    seed = int(getattr(args, "random_seed", 0))
+    registry_size = int(args.client_registry_size)
+    if getattr(args, "poison_type", None):
+        raise ValueError(
+            "poison_type is not supported with client_registry_size: "
+            "registry cohorts synthesize data on demand and the "
+            "attacks mutate eagerly-materialized shards"
+        )
+    if name.startswith("synthetic"):
+        shape = (int(getattr(args, "input_dim", 60)),)
+        class_num = int(getattr(args, "output_dim", 10))
+    else:
+        if name not in _DATASET_META:
+            raise ValueError(f"unknown dataset {name!r}")
+        shape, class_num, _, _, task = _standin_shape_and_sizes(args, name)
+        if task != "classification":
+            raise ValueError(
+                f"client_registry_size supports classification datasets "
+                f"only (dataset {name!r} is task={task!r})"
+            )
+    # fixed-size eval holdouts (a registry run's eval cost must not
+    # scale with the population); synthetic_*_size caps still win down
+    train_n = min(int(getattr(args, "synthetic_train_size", 4096)), 4096)
+    test_n = min(int(getattr(args, "synthetic_test_size", 2048)), 2048)
+    sigma = float(getattr(args, "synthetic_sigma", 1.0) or 1.0)
+    x_tr, y_tr = synthetic_classification(
+        train_n, class_num, shape, seed=seed + 3, sigma=sigma
+    )
+    x_te, y_te = synthetic_classification(
+        test_n, class_num, shape, seed=seed + 4, sigma=sigma
+    )
+    import jax.numpy as jnp
+
+    x_dtype = (
+        jnp.bfloat16
+        if str(getattr(args, "dtype", "float32") or "float32") == "bfloat16"
+        else jnp.float32
+    )
+    batch_size = int(args.batch_size)
+    logging.warning(
+        "dataset %s: client_registry_size=%d active — population lives "
+        "as columnar registry state, per-round cohorts are materialized "
+        "on demand; this dataset object carries eval holdouts only",
+        name, registry_size,
+    )
+    return FederatedDataset(
+        train_data_num=train_n,
+        test_data_num=test_n,
+        train_data_global=pack_one(x_tr, y_tr, batch_size, x_dtype=x_dtype),
+        test_data_global=pack_one(x_te, y_te, batch_size, x_dtype=x_dtype),
+        train_data_local_num_dict={},
+        train_data_local_dict={},
+        test_data_local_dict={},
+        class_num=class_num,
+        packed_train=None,
+        packed_num_samples=None,
+        packed_test=None,
+        client_num=registry_size,
+        task="classification",
+    )
+
+
 def load(args) -> FederatedDataset:
     """Load + partition + pack (data_loader.py:29 entry)."""
     name = getattr(args, "dataset", "synthetic").lower()
+    if int(getattr(args, "client_registry_size", 0) or 0) > 0:
+        # planet-scale registry (fedml_tpu/scale/): NEVER build
+        # per-client lists/arrays proportional to the registered
+        # population — cohorts materialize on demand each round
+        return _registry_dataset(args)
     client_num = int(args.client_num_in_total)
     batch_size = int(args.batch_size)
     seed = int(getattr(args, "random_seed", 0))

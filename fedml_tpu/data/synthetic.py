@@ -109,22 +109,120 @@ def synthetic_classification_device(
     means shared across train/test); the noise stream is jax's threefry
     rather than numpy's MT, which is deterministic across processes and
     backends for a given seed."""
-    import jax
     import jax.numpy as jnp
 
     dim = int(np.prod(feature_shape))
     means = _class_means(num_classes, dim, means_seed)
-    out_dtype = dtype or jnp.float32
+    return _gen_device(
+        jnp.asarray(y_packed, jnp.int32),
+        jnp.asarray(means),
+        jnp.uint32(seed),  # uint32: RandomState's full [0, 2**32) seed domain
+        jnp.float32(sigma),
+        tuple(feature_shape),
+        dtype or jnp.float32,
+    )
 
-    @jax.jit
-    def gen(y, means):
-        noise = jax.random.normal(
-            jax.random.PRNGKey(seed), y.shape + (dim,), jnp.float32
-        )
-        x = means[y] + sigma * noise
-        return x.reshape(y.shape + tuple(feature_shape)).astype(out_dtype)
 
-    return gen(jnp.asarray(y_packed, jnp.int32), jnp.asarray(means))
+def _module_jit(fn=None, **kw):
+    """jax.jit at module scope, imported lazily (this module must stay
+    importable without jax for the host-side numpy generators)."""
+    import functools
+
+    import jax
+
+    return jax.jit(fn, **kw) if fn is not None else functools.partial(
+        jax.jit, **kw
+    )
+
+
+def _gen_device_impl(y, means, seed, sigma, feature_shape, out_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    dim = means.shape[1]
+    noise = jax.random.normal(
+        jax.random.PRNGKey(seed), y.shape + (dim,), jnp.float32
+    )
+    x = means[y] + sigma * noise
+    return x.reshape(y.shape + tuple(feature_shape)).astype(out_dtype)
+
+
+def _gen_per_client_impl(y, means, client_seeds, sigma, feature_shape,
+                         out_dtype):
+    import jax
+    import jax.numpy as jnp
+
+    dim = means.shape[1]
+    C = y.shape[0]
+    flat = y.reshape(C, -1)  # [C, S] sample-ordered per client
+    S = flat.shape[1]
+    sample_idx = jnp.arange(S, dtype=jnp.uint32)
+
+    def one_client(seed, ys):
+        # noise[s] is a pure function of (client seed, sample index):
+        # independent of which cohort slot, vmap group, or nb bucket
+        # the client lands in this round — the registry's determinism
+        # contract for features
+        key = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(sample_idx)
+        noise = jax.vmap(
+            lambda k: jax.random.normal(k, (dim,), jnp.float32)
+        )(keys)
+        return means[ys] + sigma * noise
+
+    x = jax.vmap(one_client)(client_seeds, flat)
+    return x.reshape(y.shape + tuple(feature_shape)).astype(out_dtype)
+
+
+# jitted lazily on first use, then cached at module scope so repeat
+# calls (once per cohort group per round on the registry path) hit the
+# jit cache instead of rebuilding a fresh wrapper every call
+_GEN_CACHE: dict = {}
+
+
+def _gen_device(y, means, seed, sigma, feature_shape, out_dtype):
+    fn = _GEN_CACHE.get("device")
+    if fn is None:
+        fn = _GEN_CACHE["device"] = _module_jit(
+            static_argnames=("feature_shape", "out_dtype")
+        )(_gen_device_impl)
+    return fn(y, means, seed, sigma, feature_shape, out_dtype)
+
+
+def synthetic_classification_device_per_client(
+    y_packed: np.ndarray,
+    feature_shape: Tuple[int, ...],
+    num_classes: int,
+    client_seeds: np.ndarray,
+    sigma: float = 1.0,
+    means_seed: int = 1234,
+    dtype=None,
+):
+    """Per-client twin of :func:`synthetic_classification_device` for
+    the registry path (``fedml_tpu/scale/registry.py``): ``y_packed``
+    is ``[C, ...]`` with one leading row per client and
+    ``client_seeds[c]`` seeds row ``c``'s noise **per sample index**,
+    so a client's features are a function of the client alone — stable
+    across rounds, cohort slots, and nb buckets (sample ``s`` keeps its
+    noise when the client's packed shape changes). Same class-means
+    convention as the host generator."""
+    import jax.numpy as jnp
+
+    dim = int(np.prod(feature_shape))
+    means = _class_means(num_classes, dim, means_seed)
+    fn = _GEN_CACHE.get("per_client")
+    if fn is None:
+        fn = _GEN_CACHE["per_client"] = _module_jit(
+            static_argnames=("feature_shape", "out_dtype")
+        )(_gen_per_client_impl)
+    return fn(
+        jnp.asarray(y_packed, jnp.int32),
+        jnp.asarray(means),
+        jnp.asarray(client_seeds, jnp.uint32),
+        jnp.float32(sigma),
+        tuple(feature_shape),
+        dtype or jnp.float32,
+    )
 
 
 def synthetic_segmentation(

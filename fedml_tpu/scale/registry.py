@@ -1,0 +1,242 @@
+"""Columnar client registry: a million registered clients in megabytes.
+
+FedML Parrot (arXiv:2303.01778) and FedJAX (arXiv:2108.02117) both
+locate planet-scale simulation in the same design move: client state is
+*data*, not objects. A registered client here is one row across four
+columns — dataset size, speed tier, data-shard offset, per-client seed
+— about 17 bytes, so a 1M-client registry is ~17 MB of NumPy (or
+disk-backed memmap) instead of a million Python dataset objects.
+
+Everything per-round is O(cohort):
+
+- ``sample_cohort`` draws a without-replacement cohort with Floyd's
+  algorithm — a hash-set of exactly ``cohort_size`` draws. It never
+  builds ``arange(N)`` or a permutation of the registry
+  (``np.random.choice(N, k, replace=False)`` permutes all N under the
+  hood, which is exactly the eager O(total-clients) work this module
+  exists to remove).
+- ``client_labels`` / ``materialize_group`` generate a client's data on
+  demand from its own seed column (device-synth path, the zero-egress
+  stand-in convention of ``data/synthetic.py``); ``shard_slice`` is the
+  equivalent seam for real datasets stored as one contiguous shard file
+  (offset/length reads instead of per-client arrays).
+
+Determinism contract: the same ``(seed, size)`` registry produces the
+same columns, the same ``(registry, round_idx)`` produces the same
+cohort, and the same client index produces the same data on every
+materialization — asserted in ``tests/test_planet_scale.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ClientRegistry"]
+
+# column name -> dtype; the registry's entire per-client schema. One
+# row is 4 + 1 + 8 + 4 = 17 bytes.
+_COLUMNS = (
+    ("num_samples", np.int32),
+    ("speed_tier", np.int8),
+    ("shard_offset", np.int64),
+    ("client_seed", np.uint32),
+)
+
+
+class ClientRegistry:
+    """N registered clients as columnar arrays with O(cohort) access.
+
+    ``size``: registered population (N). ``seed``: generates every
+    column (and, folded with the round index, every cohort draw).
+    ``min_samples``/``max_samples``: lognormal per-client dataset sizes
+    are clipped into this range (the ``synthetic_fedprox`` convention —
+    a heavy-tailed, heterogeneous population). ``speed_tiers``: number
+    of device-speed classes; tier ``t`` is modeled as ``2**t`` x slower
+    per sample by the cohort packer's LPT balancing.
+    ``memmap_dir``: when given, columns live in ``<dir>/<name>.npy``
+    memmaps (written once, reopened read-only) so even the O(N) column
+    footprint leaves host RAM.
+    """
+
+    def __init__(
+        self,
+        size: int,
+        seed: int = 0,
+        min_samples: int = 20,
+        max_samples: int = 400,
+        speed_tiers: int = 3,
+        memmap_dir: Optional[str] = None,
+    ) -> None:
+        if size < 1:
+            raise ValueError(f"registry size {size}: must be >= 1")
+        if not 1 <= min_samples <= max_samples:
+            raise ValueError(
+                f"sample bounds [{min_samples}, {max_samples}] invalid"
+            )
+        if speed_tiers < 1:
+            raise ValueError(f"speed_tiers={speed_tiers}: must be >= 1")
+        self.size = int(size)
+        self.seed = int(seed)
+        self.min_samples = int(min_samples)
+        self.max_samples = int(max_samples)
+        self.speed_tiers = int(speed_tiers)
+        cols = self._generate_columns()
+        if memmap_dir is not None:
+            cols = self._to_memmap(cols, memmap_dir)
+        self.num_samples: np.ndarray = cols["num_samples"]
+        self.speed_tier: np.ndarray = cols["speed_tier"]
+        self.shard_offset: np.ndarray = cols["shard_offset"]
+        self.client_seed: np.ndarray = cols["client_seed"]
+        self.total_samples = int(
+            self.shard_offset[-1] + self.num_samples[-1]
+        )
+        # flat-memory claims are measured, not asserted in prose
+        from ..core.telemetry import Telemetry
+
+        Telemetry.get_instance().set_gauge(
+            "registry_clients_total", self.size
+        )
+
+    # -- column synthesis ---------------------------------------------
+    def _generate_columns(self) -> Dict[str, np.ndarray]:
+        rng = np.random.RandomState(self.seed)
+        n = np.clip(
+            rng.lognormal(4.0, 1.0, self.size), self.min_samples,
+            self.max_samples,
+        ).astype(np.int32)
+        tier = rng.randint(0, self.speed_tiers, self.size).astype(np.int8)
+        cseed = rng.randint(
+            0, 2**31 - 1, size=self.size, dtype=np.int64
+        ).astype(np.uint32)
+        # prefix-sum offsets: client i's samples live at
+        # [offset[i], offset[i] + num_samples[i]) of a contiguous shard
+        off = np.zeros(self.size, dtype=np.int64)
+        np.cumsum(n[:-1], out=off[1:])
+        return {
+            "num_samples": n,
+            "speed_tier": tier,
+            "shard_offset": off,
+            "client_seed": cseed,
+        }
+
+    @staticmethod
+    def _to_memmap(
+        cols: Dict[str, np.ndarray], memmap_dir: str
+    ) -> Dict[str, np.ndarray]:
+        os.makedirs(memmap_dir, exist_ok=True)
+        out: Dict[str, np.ndarray] = {}
+        for name, dtype in _COLUMNS:
+            path = os.path.join(memmap_dir, f"{name}.npy")
+            mm = np.lib.format.open_memmap(
+                path, mode="w+", dtype=dtype, shape=cols[name].shape
+            )
+            mm[:] = cols[name]
+            mm.flush()
+            del mm
+            out[name] = np.load(path, mmap_mode="r")
+        return out
+
+    def nbytes(self) -> int:
+        """Registry column footprint in bytes (~17 per client)."""
+        return int(
+            sum(
+                getattr(self, name).dtype.itemsize
+                for name, _ in _COLUMNS
+            )
+            * self.size
+        )
+
+    # -- O(cohort) sampling -------------------------------------------
+    def sample_cohort(self, round_idx: int, cohort_size: int) -> np.ndarray:
+        """Deterministic without-replacement cohort for ``round_idx``.
+
+        Floyd's algorithm: k draws, a k-sized set, no ``arange(N)`` /
+        permutation — peak memory is O(cohort) no matter how large the
+        registry is (asserted with tracemalloc in the tests). Returns
+        sorted int64 registry indices; sorting keeps downstream
+        grouping independent of draw order."""
+        k = int(cohort_size)
+        n = self.size
+        if not 1 <= k <= n:
+            raise ValueError(
+                f"cohort_size={k} out of range for registry size {n}"
+            )
+        rs = np.random.RandomState(
+            (self.seed * 1_000_003 + int(round_idx)) % (2**32)
+        )
+        chosen: set = set()
+        for j in range(n - k, n):
+            t = int(rs.randint(0, j + 1))
+            chosen.add(t if t not in chosen else j)
+        return np.fromiter(sorted(chosen), dtype=np.int64, count=k)
+
+    # -- O(cohort) materialization ------------------------------------
+    def shard_slice(self, index: int) -> Tuple[int, int]:
+        """(offset, length) of client ``index``'s samples in a
+        contiguous on-disk data shard — the read plan for real datasets
+        (the synthetic path below generates instead of reading; both
+        touch only the requested client)."""
+        return int(self.shard_offset[index]), int(self.num_samples[index])
+
+    def client_labels(self, index: int, class_num: int) -> np.ndarray:
+        """Client ``index``'s label vector, regenerated on demand from
+        its own seed column — identical on every materialization, and a
+        function of the client alone (not of which cohort or group it
+        happens to land in)."""
+        rs = np.random.RandomState(int(self.client_seed[index]))
+        return rs.randint(
+            0, int(class_num), int(self.num_samples[index])
+        ).astype(np.int64)
+
+    def materialize_group(
+        self,
+        client_idx: np.ndarray,
+        num_batches: int,
+        batch_size: int,
+        feature_shape: Tuple[int, ...],
+        class_num: int,
+        sigma: float = 1.0,
+        dtype=None,
+    ):
+        """One packed cohort group -> device ``Batches``.
+
+        Labels are generated per client (KBs) and packed host-side;
+        the feature tensor is synthesized directly on the device
+        (``data/synthetic.synthetic_classification_device_per_client``),
+        so the host never holds a group's images and the host->device
+        link carries labels + masks only. Each row's noise is keyed by
+        that client's seed column per sample index, so features — like
+        labels — are a function of the client alone, not of which slot,
+        group shape, or cohort it lands in. Returns ``(batches,
+        num_samples[C])``; padded label slots carry mask 0 exactly as
+        in ``data/packing.py``."""
+        import jax.numpy as jnp
+
+        from ..core.types import Batches
+        from ..data.packing import pack_labels_np
+        from ..data.synthetic import (
+            synthetic_classification_device_per_client,
+        )
+
+        # pre-truncate to the group's packed capacity: the waste-cap
+        # truncation was already decided (and counted) by pack_cohort,
+        # so the packer must not re-warn per group per round
+        cap = int(num_batches) * int(batch_size)
+        ys = [
+            self.client_labels(int(i), class_num)[:cap] for i in client_idx
+        ]
+        y_p, mask, num_samples = pack_labels_np(
+            ys, batch_size, num_batches=int(num_batches)
+        )
+        x = synthetic_classification_device_per_client(
+            y_p, tuple(feature_shape), int(class_num),
+            self.client_seed[np.asarray(client_idx, dtype=np.int64)],
+            sigma=float(sigma), dtype=dtype,
+        )
+        batches = Batches(
+            x=x, y=jnp.asarray(y_p, jnp.int32), mask=jnp.asarray(mask)
+        )
+        return batches, num_samples

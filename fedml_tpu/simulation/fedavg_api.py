@@ -336,15 +336,23 @@ class FedAvgAPI:
     # -- round loop ----------------------------------------------------
     def train(self) -> Dict[str, float]:
         args = self.args
-        # jit inputs under multi-controller must be global arrays or
-        # process-consistent host values — never locally-committed
-        # device arrays (every process holds the same host copy)
-        packed = self.dataset.packed_train
-        nsamples = (
-            np.asarray(self.dataset.packed_num_samples)
-            if self._multi_controller
-            else jnp.asarray(self.dataset.packed_num_samples)
-        )
+        from ..scale.engine import planet_knobs_active
+
+        if planet_knobs_active(args):
+            # registry-backed population plane (fedml_tpu/scale/): no
+            # eager federation exists to pack — the planet loop samples
+            # and materializes each round's cohort on demand
+            packed = nsamples = None
+        else:
+            # jit inputs under multi-controller must be global arrays or
+            # process-consistent host values — never locally-committed
+            # device arrays (every process holds the same host copy)
+            packed = self.dataset.packed_train
+            nsamples = (
+                np.asarray(self.dataset.packed_num_samples)
+                if self._multi_controller
+                else jnp.asarray(self.dataset.packed_num_samples)
+            )
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
         ckpt, start_round = self._maybe_restore()
@@ -389,6 +397,20 @@ class FedAvgAPI:
     def _train_rounds(
         self, packed, nsamples, comm_rounds, freq, ckpt, start_round
     ) -> Dict[str, float]:
+        from ..scale.engine import PlanetRoundLoop, planet_knobs_active
+
+        if planet_knobs_active(self.args):
+            # registry-backed cohorts (ROADMAP item 2): O(cohort) host
+            # memory per round from a million-client registry, two-tier
+            # edge aggregation behind edge_num. The loop (registry +
+            # per-shape jit cache) persists across train() calls so a
+            # warm re-run replays with zero new compiles
+            loop = getattr(self, "_planet_loop", None)
+            if loop is None:
+                loop = self._planet_loop = PlanetRoundLoop(self)
+            return loop.run(
+                packed, nsamples, comm_rounds, freq, ckpt, start_round
+            )
         if self.mode != "sequential" and not self._keep_stacked:
             # the async executor (K rounds in flight, deferred metrics,
             # shape-bucketed compile cache); pipeline_depth=1 (default)

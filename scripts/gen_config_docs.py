@@ -201,6 +201,10 @@ GROUPS = [
         "serve_queue_size", "serve_max_batch", "serve_batch_wait_ms",
         "serve_deadline_ms", "serve_bucket", "serve_watch_interval_s",
     ]),
+    ("Planet scale (registry-backed populations)", [
+        "client_registry_size", "cohort_size", "edge_num",
+        "registry_dir", "edge_flat_fold",
+    ]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
         "telemetry", "telemetry_dir", "stall_timeout_s", "trace_ring_size",

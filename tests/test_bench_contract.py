@@ -123,6 +123,19 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"defense"' in parent or "'defense'" in parent
 
+    def test_planet_phase_contract(self):
+        """detail.planet ships the planet-scale population evidence
+        (registry-backed rounds/s, warm-run RSS flat in registry size,
+        two-tier tree aggregation bit-identical to flat, jit-trace
+        census within the pow2 bucket budget): the phase is in the
+        child vocabulary and the parent stitches it (like defense, it
+        runs demoted on the CPU fallback)."""
+        assert "planet" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"planet"' in parent or "'planet'" in parent
+
     def test_tracing_phase_contract(self):
         """detail.tracing ships the distributed-tracing evidence
         (matched cross-process flows, critical-path segment sums,
@@ -326,6 +339,40 @@ class TestPhaseChild:
         assert a["clipped_uploads"] > 0
         assert a["quarantine_rejected_uploads"] >= 1
         assert a["defended_within_bound"] is True
+
+    @pytest.mark.slow  # ~100s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's planet smoke block
+    def test_planet_smoke_child_writes_valid_json(self):
+        """The CI planet smoke invocation (100k registry, 1k cohort,
+        3 rounds, CPU): the registry-backed population plane runs
+        end-to-end through bench.py's planet phase child and emits the
+        detail.planet contract keys — rounds completing at measured
+        rounds/s, the warm-run RSS delta of a 10x-bigger registry
+        within cohort-scale slack of the small one, two-tier edge-tree
+        aggregation bit-identical to the flat fold of the same per-edge
+        terms, and one jit trace per (bucket, nb) shape inside the pow2
+        census budget."""
+        d = self._run_child("planet", 500, smoke=True)
+        assert d["registry_clients"] == 100_000
+        assert d["registry_clients_small"] == 10_000
+        assert d["cohort_size"] == 1_000
+        assert d["rounds"] == 3
+        assert d["edge_num"] >= 2
+        assert d["rounds_per_sec"] > 0
+        # flat-memory evidence: registry columns are ~17 bytes/client
+        # and the warm-round RSS delta tracks the cohort, not the 10x
+        # registry
+        assert d["registry_bytes"] <= 32 * d["registry_clients"]
+        assert d["rss_measured"] is True
+        assert d["rss_scales_with_cohort"] is True
+        assert d["planet_peak_rss_bytes"] > 0
+        # two-tier tree == flat, bit for bit
+        assert d["tree_identical_to_flat"] is True
+        assert d["max_abs_diff_tree_vs_flat"] == 0.0
+        # compile census: one trace per pow2 shape key, within budget
+        assert d["one_trace_per_shape"] is True
+        assert d["trace_within_budget"] is True
+        assert d["trace_count"] <= d["trace_budget"]
 
     @pytest.mark.slow  # ~90s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's tracing smoke block
