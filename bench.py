@@ -81,7 +81,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
     "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
-    "planet",
+    "chaosplan", "planet",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -1085,7 +1085,9 @@ def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
 
     Telemetry.reset()
     ckpt_dir = _tempfile.mkdtemp(prefix="bench_chaos_ck_")
+    tel_dir = _tempfile.mkdtemp(prefix="bench_chaos_td_")
     chaos_kw["checkpoint_dir"] = ckpt_dir
+    chaos_kw["telemetry_dir"] = tel_dir
     server1, cclients = build_world("bench_chaos", **chaos_kw)
 
     # client kill: rank 2's handler dies (kill -9 analog: the exception
@@ -1210,6 +1212,9 @@ def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
         "exactly_once": aggregated == expected,
         "max_abs_diff_vs_clean": diff,
         "params_match_clean": diff == 0.0,
+        # post-hoc invariant replay over the world's artifacts (WAL +
+        # telemetry + trace) — the reusable checker, not hand asserts
+        **_check_invariants(tel_dir, ckpt_dir),
     }
     _progress(
         f"chaos: {out['rounds_completed']}/{rounds} rounds, "
@@ -1355,6 +1360,8 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
         pass
 
     Telemetry.reset()
+    q_ck = _tempfile.mkdtemp(prefix="bench_strag_qck_")
+    q_td = _tempfile.mkdtemp(prefix="bench_strag_qtd_")
     qserver, qclients = build_world(
         "bench_strag_quorum",
         agg_mode="stream",
@@ -1362,6 +1369,13 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
         round_grace_s=1.0,
         heartbeat_interval_s=0.1,
         heartbeat_timeout_s=1.5,
+        # the WAL (created with the dir) is all the invariant checker
+        # needs; this world is TIMING-gated (quorum_wall vs the
+        # blocked bound), so a per-round orbax save must not inflate
+        # the wall the gate measures
+        checkpoint_dir=q_ck,
+        checkpoint_freq=10_000,
+        telemetry_dir=q_td,
     )
     drain = threading.Event()  # post-run: stop sleeping, drain fast
     slow_trainer = qclients[2].trainer
@@ -1422,6 +1436,9 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
         "blocked_wall_bound_s": blocked_bound,
         "tracks_quorum_not_straggler": quorum_wall < 0.75 * blocked_bound,
         "peak_buffered": qserver.aggregator.peak_buffered,
+        # the checker must account every partial close to the quorum /
+        # death counters from artifacts alone
+        **_check_invariants(q_td, q_ck),
     }
     _progress(
         f"straggler: quorum world {quorum_wall:.1f}s vs blocked bound "
@@ -1434,8 +1451,10 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
 
     Telemetry.reset()
     ckpt_dir = _tempfile.mkdtemp(prefix="bench_strag_ck_")
+    async_td = _tempfile.mkdtemp(prefix="bench_strag_atd_")
     async_kw = dict(
         agg_mode="async",
+        telemetry_dir=async_td,
         async_publish_every=2,
         staleness_decay=0.5,
         staleness_max=64,
@@ -1584,6 +1603,9 @@ def run_straggler(on_cpu: bool, smoke: bool = False) -> dict:
         "dup_dropped_total": atotal("comm_dup_dropped_total"),
         "retries_total": atotal("comm_retries_total"),
         "wall_s": round(async_wall, 2),
+        # the reusable checker re-derives the exactly-once /
+        # monotonicity evidence from the WAL + telemetry artifacts
+        **_check_invariants(async_td, ckpt_dir),
     }
     _progress(
         f"straggler: async {amgr2.async_folds}/{amgr2._async_target_folds()} "
@@ -1624,6 +1646,7 @@ def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
        the clean run.
 
     ``smoke`` (CI gate): same worlds at the mini scale."""
+    import tempfile as _tempfile
     import threading
 
     import jax
@@ -1786,10 +1809,13 @@ def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
     )
 
     # -- 3: defended poisoned world under drop/dup faults -------------
+    def_ck = _tempfile.mkdtemp(prefix="bench_def_ck_")
+    def_td = _tempfile.mkdtemp(prefix="bench_def_td_")
     defended, def_stats = run_world(
         "bench_def_def", agg_mode="stream",
         reliable_comm=True, comm_retry_max=8, comm_retry_base_s=0.05,
         fault_injection={"drop_prob": 0.15, "duplicate_prob": 0.15, "seed": 5},
+        checkpoint_dir=def_ck, checkpoint_freq=1, telemetry_dir=def_td,
         **poison_kw, **defense_kw,
     )
     tel = Telemetry.get_instance()
@@ -1828,6 +1854,9 @@ def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
     out["dup_uploads_ignored"] = total("agg_dup_uploads_ignored_total")
     out["comm_dup_dropped"] = total("comm_dup_dropped_total")
     out["exactly_once"] = folds == aggregated and folds <= n_clients * rounds
+    # post-hoc replay: quarantine-shrunken cohorts must be accounted by
+    # the defense counters, folds by the WAL ledger
+    out.update(_check_invariants(def_td, def_ck))
     _progress(
         f"defense: defended loss {out['defended_loss']:.4f}, quarantined "
         f"{quarantined} (attackers {attacker_ranks}), "
@@ -1835,9 +1864,12 @@ def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
     )
 
     # -- 4: async defended world --------------------------------------
+    adef_ck = _tempfile.mkdtemp(prefix="bench_def_ack_")
+    adef_td = _tempfile.mkdtemp(prefix="bench_def_atd_")
     asrv, async_stats = run_world(
         "bench_def_async", agg_mode="async", async_publish_every=3,
         staleness_decay=0.5, staleness_max=64,
+        checkpoint_dir=adef_ck, checkpoint_freq=1, telemetry_dir=adef_td,
         **poison_kw, **defense_kw,
     )
     tel = Telemetry.get_instance()
@@ -1865,10 +1897,441 @@ def run_defense(on_cpu: bool, smoke: bool = False) -> dict:
         "defended_within_bound": (
             float(async_stats["loss"]) < 0.5 * out["undefended_loss"]
         ),
+        **_check_invariants(adef_td, adef_ck),
     }
     _progress(
         f"defense: async loss {out['async']['loss']:.4f}, quarantined {aq}, "
         f"{asrv.manager.async_folds}/{asrv.manager._async_target_folds()} folds"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
+def _check_invariants(telemetry_dir, checkpoint_dir=None) -> dict:
+    """Run the post-hoc InvariantChecker over a finished world's
+    artifacts and fold its verdict into the phase JSON — the shared
+    tail of every chaos/straggler/defense/chaosplan world."""
+    from fedml_tpu.core.invariants import InvariantChecker
+
+    rep = InvariantChecker(
+        telemetry_dir=telemetry_dir, checkpoint_dir=checkpoint_dir
+    ).check()
+    d = rep.to_dict()
+    return {
+        "invariants_ok": d["ok"],
+        "invariants_checked": d["checked"],
+        "invariants_violations": d["violations"],
+    }
+
+
+def run_chaosplan(on_cpu: bool, smoke: bool = False) -> dict:
+    """Chaos-plane phase (docs/robustness.md chaos schedule DSL): the
+    deterministic, schedulable fault layer as measured contracts —
+
+    1. **determinism pair**: one LOCAL world run twice under the SAME
+       ``ChaosSchedule`` + seed (exact message-N drop/dup/delay through
+       the FaultInjector's plan seam, WAL IO latency + failed fsync
+       through the DurableIO seam, a clock-skew barrier fault): the
+       fault trace must be IDENTICAL across runs — same
+       ``chaos_faults_injected_total`` counter series, same
+       ``chaos.fault`` trace-event signature, every step fired.
+    2. **crash-point sweep** (CrashMonkey-style, exhaustive): a short
+       checkpointed world runs once under ``RecordingIO`` to enumerate
+       EVERY WAL-append / checkpoint-publish write boundary, then
+       re-runs once per crash point killing the server exactly there
+       (before / torn-at-byte-K / after). Every re-run must recover
+       (restart from checkpoint+WAL, all rounds complete) with the
+       ``InvariantChecker`` clean.
+    3. **combined world**: async staleness-weighted aggregation +
+       norm-clipping defense, with the cohort's per-client dataset
+       sizes drawn from a 100k-client ``ClientRegistry``, under a
+       scripted schedule (exact upload drop recovered by retransmit,
+       duplicate eaten by dedup, delayed dispatch, one scheduled
+       client kill at the ``client.train`` barrier, WAL latency, clock
+       skew): reaches its fold target and the checker proves
+       exactly-once folds, version monotonicity and no reissued seqs
+       from artifacts.
+
+    ``smoke`` (CI gate): the same three sections at mini scale."""
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu import constants as C
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core import checkpoint as ckpt_mod
+    from fedml_tpu.core.chaos import (
+        ProcessKilled,
+        RecordingIO,
+        active_chaos,
+        crash_point_schedule,
+        enumerate_crash_points,
+        reset_chaos,
+    )
+    from fedml_tpu.core.invariants import InvariantChecker
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.data import load
+
+    UPLOAD = int(C.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER)
+
+    def mk(rank, run_id, n_clients, rounds, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = 120
+        a.synthetic_test_size = 40
+        a.model = "lr"
+        a.partition_method = "hetero"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 1
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def build_world(run_id, n_clients, rounds, client_kw=None, **kw):
+        a0, ds0, m0 = mk(0, run_id, n_clients, rounds, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in range(1, n_clients + 1):
+            per = dict(kw)
+            per.update((client_kw or {}).get(r, {}))
+            a, ds, m = mk(r, run_id, n_clients, rounds, **per)
+            clients.append(Client(a, None, ds, m))
+        return server, clients
+
+    def start_clients(clients, run_id):
+        def client_thread(c):
+            try:
+                c.run()
+            except ProcessKilled:
+                pass  # a scheduled kill_client took this 'process' down
+
+        threads = [
+            threading.Thread(
+                target=client_thread, args=(c,), daemon=True,
+                name=f"{run_id}-c{i}",
+            )
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+    def join_all(threads, note):
+        for t in threads:
+            t.join(timeout=120)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(f"chaosplan {note}: threads hung: {hung}")
+
+    out = {"device": str(jax.devices()[0])}
+
+    # -- 1: determinism pair ------------------------------------------
+    det_clients, det_rounds = 3, 3
+    det_schedule = [
+        # rank 1's first upload never leaves — the reliable channel's
+        # retransmit re-traverses the injector (step is one-shot) and
+        # recovers it
+        {"at": {"event": "send", "msg_type": UPLOAD, "rank": 1,
+                "occurrence": 1}, "fault": "drop"},
+        # rank 2's second upload goes out twice — receive-side dedup
+        {"at": {"event": "send", "msg_type": UPLOAD, "rank": 2,
+                "occurrence": 2}, "fault": "duplicate"},
+        # rank 3's first upload arrives 0.2s late
+        {"at": {"event": "send", "msg_type": UPLOAD, "rank": 3,
+                "occurrence": 1}, "fault": {"kind": "delay", "delay_s": 0.2}},
+        # durable-IO faults: a slow append, then a refused fsync (the
+        # WAL's degraded-durability OSError path, not a crash)
+        {"at": {"event": "wal_append", "occurrence": 1},
+         "fault": {"kind": "latency", "delay_s": 0.05}},
+        {"at": {"event": "wal_append", "occurrence": 2},
+         "fault": "fsync_fail"},
+        # an NTP step mid-federation: the trace stitcher's problem, not
+        # the monotonic-clock consumers'
+        {"at": {"event": "barrier", "name": "server.round_close",
+                "occurrence": 2}, "fault": {"kind": "clock_skew",
+                                            "skew_s": 0.5}},
+    ]
+
+    def run_det(tag):
+        reset_chaos()
+        Telemetry.reset()
+        ckpt_dir = _tempfile.mkdtemp(prefix=f"bench_cp_det{tag}_")
+        server, clients = build_world(
+            "bench_chaosplan_det", det_clients, det_rounds,
+            chaos_schedule=det_schedule, chaos_seed=11,
+            reliable_comm=True, comm_retry_max=8, comm_retry_base_s=0.05,
+            checkpoint_dir=ckpt_dir, checkpoint_freq=1,
+        )
+        threads = start_clients(clients, f"det{tag}")
+        server.run()
+        join_all(threads, f"determinism run {tag}")
+        tel = Telemetry.get_instance()
+        sched = active_chaos()
+        sig = InvariantChecker.fault_signature(
+            tel.recorder.tail(len(tel.recorder))
+        )
+        fired = sorted(
+            (f["step"], f["event"], f["fault"]) for f in sched.fired
+        )
+        counters = dict(tel.counters_matching("chaos_faults_injected_total"))
+        return {
+            "signature": sig,
+            "fired": fired,
+            "counters": counters,
+            "pending": sched.pending(),
+            "rounds": server.manager.round_idx,
+        }
+
+    d1 = run_det("a")
+    d2 = run_det("b")
+    out["determinism"] = {
+        "steps": len(det_schedule),
+        "faults_fired": len(d1["fired"]),
+        "all_steps_fired": d1["pending"] == 0 and d2["pending"] == 0,
+        "counters_identical": d1["counters"] == d2["counters"],
+        "trace_signature_identical": d1["signature"] == d2["signature"],
+        "identical_fault_trace": (
+            d1["counters"] == d2["counters"]
+            and d1["signature"] == d2["signature"]
+            and d1["fired"] == d2["fired"]
+        ),
+        "rounds_completed": [d1["rounds"], d2["rounds"]],
+    }
+    _progress(
+        f"chaosplan: determinism pair fired {len(d1['fired'])}/"
+        f"{len(det_schedule)} steps, identical="
+        f"{out['determinism']['identical_fault_trace']}"
+    )
+
+    # -- 2: crash-point sweep -----------------------------------------
+    sweep_clients, sweep_rounds = 2, 2
+    sweep_kw = dict(
+        checkpoint_freq=1,
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=60.0,
+    )
+
+    # enumeration run: record every durable-write boundary
+    reset_chaos()
+    Telemetry.reset()
+    recorder = RecordingIO()
+    ckpt_mod.install_io_seam(recorder)
+    try:
+        enum_ck = _tempfile.mkdtemp(prefix="bench_cp_enum_")
+        server, clients = build_world(
+            "bench_chaosplan_enum", sweep_clients, sweep_rounds,
+            checkpoint_dir=enum_ck, **sweep_kw,
+        )
+        threads = start_clients(clients, "enum")
+        server.run()
+        join_all(threads, "enumeration run")
+    finally:
+        ckpt_mod.reset_io_seam()
+    points = enumerate_crash_points(recorder.events)
+    _progress(
+        f"chaosplan: enumerated {len(points)} crash points from "
+        f"{len(recorder.events)} write boundaries"
+    )
+
+    sweep_results = []
+    for point in points:
+        reset_chaos()
+        Telemetry.reset()
+        ck = _tempfile.mkdtemp(prefix="bench_cp_sweep_")
+        td = _tempfile.mkdtemp(prefix="bench_cp_sweept_")
+        kill_kw = dict(
+            sweep_kw,
+            checkpoint_dir=ck,
+            telemetry_dir=td,
+            chaos_schedule=crash_point_schedule(point),
+        )
+        server1, clients = build_world(
+            "bench_chaosplan_sweep", sweep_clients, sweep_rounds, **kill_kw
+        )
+        killed = {}
+
+        def server_thread():
+            try:
+                server1.run()
+            except ProcessKilled as e:
+                killed["where"] = e.where
+                # the 'process' died: its detector/watchdog threads too
+                if server1.manager._failure_detector is not None:
+                    server1.manager._failure_detector.stop()
+
+        threads = start_clients(clients, "sweep")
+        st = threading.Thread(
+            target=server_thread, daemon=True, name="sweep-srv"
+        )
+        st.start()
+        st.join(timeout=120)
+        if st.is_alive() or not killed:
+            raise RuntimeError(
+                f"chaosplan sweep: crash point {point} never killed the "
+                "server (or it hung)"
+            )
+        # restart: same schedule spec -> the already-fired one-shot
+        # step is reused, so the resumed server runs fault-free
+        a0b, ds0b, m0b = mk(
+            0, "bench_chaosplan_sweep", sweep_clients, sweep_rounds, **kill_kw
+        )
+        server2 = Server(a0b, None, ds0b, m0b)
+        resumed_at = server2.manager.round_idx
+        server2.run()
+        join_all(threads, f"sweep point {point}")
+        inv = _check_invariants(td, ck)
+        sweep_results.append(
+            {
+                **point,
+                "killed_at": killed["where"],
+                "resumed_at_round": resumed_at,
+                "rounds_completed": server2.manager.round_idx,
+                "recovered": server2.manager.round_idx >= sweep_rounds,
+                "invariants_ok": inv["invariants_ok"],
+                "violations": inv["invariants_violations"],
+            }
+        )
+        _progress(
+            f"chaosplan: crash point {point['event']}#"
+            f"{point['occurrence']}/{point['mode']} -> resumed at "
+            f"{resumed_at}, clean={inv['invariants_ok']}"
+        )
+    out["sweep"] = {
+        "write_boundaries": len(recorder.events),
+        "crash_points": len(points),
+        "recovered": sum(1 for r in sweep_results if r["recovered"]),
+        "all_recovered": all(r["recovered"] for r in sweep_results),
+        "all_invariants_clean": all(
+            r["invariants_ok"] for r in sweep_results
+        ),
+        "points": sweep_results,
+    }
+
+    # -- 3: combined async + defense + registry-drawn cohort ----------
+    from fedml_tpu.scale.registry import ClientRegistry
+
+    comb_clients = 3 if smoke else 4
+    comb_rounds = 3
+    reset_chaos()
+    Telemetry.reset()
+    registry = ClientRegistry(100_000, seed=17)
+    cohort_ids = [int(i) for i in registry.sample_cohort(0, comb_clients)]
+    # the cohort's heterogeneity comes from the registry columns: each
+    # cross-silo client trains the dataset size its registry row says
+    sizes = [
+        int(min(max(int(registry.num_samples[cid]) * 2, 96), 320))
+        for cid in cohort_ids
+    ]
+    comb_ck = _tempfile.mkdtemp(prefix="bench_cp_comb_")
+    comb_td = _tempfile.mkdtemp(prefix="bench_cp_combt_")
+    comb_schedule = [
+        {"at": {"event": "send", "msg_type": UPLOAD, "rank": 1,
+                "occurrence": 1}, "fault": "drop"},
+        {"at": {"event": "send", "msg_type": UPLOAD, "rank": 3,
+                "occurrence": 2}, "fault": "duplicate"},
+        {"at": {"event": "send", "rank": 0, "occurrence": 4,
+                "msg_type": int(C.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)},
+         "fault": {"kind": "delay", "delay_s": 0.1}},
+        # rank 2 dies on its second dispatch (kill -9 analog at the
+        # client.train barrier); the failure detector declares it and
+        # async retires its outstanding work
+        {"at": {"event": "barrier", "name": "client.train", "rank": 2,
+                "occurrence": 2}, "fault": "kill_client"},
+        {"at": {"event": "wal_append", "occurrence": 1},
+         "fault": {"kind": "latency", "delay_s": 0.05}},
+        {"at": {"event": "barrier", "name": "server.publish",
+                "occurrence": 2}, "fault": {"kind": "clock_skew",
+                                            "skew_s": 0.25}},
+    ]
+    comb_kw = dict(
+        agg_mode="async",
+        async_publish_every=2,
+        staleness_decay=0.5,
+        staleness_max=64,
+        defense_type="norm_diff_clipping",
+        norm_bound=1.0,
+        reliable_comm=True,
+        comm_retry_max=8,
+        comm_retry_base_s=0.05,
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=1.5,
+        checkpoint_dir=comb_ck,
+        checkpoint_freq=1,
+        telemetry_dir=comb_td,
+        chaos_schedule=comb_schedule,
+        chaos_seed=23,
+    )
+    client_kw = {
+        r: {"synthetic_train_size": sizes[r - 1]}
+        for r in range(1, comb_clients + 1)
+    }
+    aserver, aclients = build_world(
+        "bench_chaosplan_comb", comb_clients, comb_rounds,
+        client_kw=client_kw, **comb_kw,
+    )
+    t0 = time.perf_counter()
+    threads = start_clients(aclients, "comb")
+    aserver.run()
+    comb_dt = time.perf_counter() - t0
+    join_all(threads, "combined world")
+    tel = Telemetry.get_instance()
+
+    def total(counter):
+        return sum(tel.counters_matching(counter).values())
+
+    sched = active_chaos()
+    inv = _check_invariants(comb_td, comb_ck)
+    mgr = aserver.manager
+    out["combined"] = {
+        "registry_clients": registry.size,
+        "cohort_client_ids": cohort_ids,
+        "client_train_sizes": sizes,
+        "clients": comb_clients,
+        "folds_total": mgr.async_folds,
+        "target_folds": mgr._async_target_folds(),
+        "reached_fold_target": mgr.async_folds >= mgr._async_target_folds(),
+        "publishes": mgr.version,
+        # the kill is proven by the fired schedule step; the detector's
+        # DECLARATION is timing-dependent (the fold target can be
+        # reached by the survivors inside the heartbeat timeout) and is
+        # reported separately
+        "client_killed": any(
+            f["fault"] == "kill_client" for f in (sched.fired if sched else [])
+        ),
+        "deaths_declared": total("cross_silo_clients_declared_dead_total"),
+        "clipped_uploads": aserver.aggregator.defense_clipped,
+        "chaos_faults": total("chaos_faults_injected_total"),
+        "steps_fired": len(sched.fired) if sched is not None else 0,
+        "retries_total": total("comm_retries_total"),
+        "dup_dropped_total": total("comm_dup_dropped_total"),
+        "wall_s": round(comb_dt, 2),
+        **inv,
+    }
+    reset_chaos()
+    _progress(
+        f"chaosplan: combined world {mgr.async_folds}/"
+        f"{mgr._async_target_folds()} folds, "
+        f"{out['combined']['chaos_faults']:.0f} scheduled faults, "
+        f"invariants_ok={inv['invariants_ok']}"
     )
     if on_cpu:
         out["cpu_fallback"] = True
@@ -2417,6 +2880,11 @@ _STRAGGLER_TIMEOUT_S = 360.0
 # undefended, poisoned defended under drop/dup faults, poisoned async)
 # — all mini LR cohorts; dominated by jit compiles on a cold box
 _DEFENSE_TIMEOUT_S = 360.0
+# determinism pair + a ~11-world crash-point sweep (one re-run per
+# enumerated WAL/checkpoint write boundary) + the combined
+# async/defense/registry world — each a mini LR world, jit-compile
+# dominated on a cold box
+_CHAOSPLAN_TIMEOUT_S = 420.0
 # three registry apis (small, big, flat baseline) x warm+timed train()
 # pairs; registry/cohort work is numpy-light, the window is for the
 # per-(bucket, nb) jit compiles on a cold box
@@ -2708,6 +3176,12 @@ def _main_guarded() -> None:
     # attacker quarantine through the drop-expected path, async
     # staleness-aware defenses, exactly-once accounting intact
     _run_demoted_phase("defense", _DEFENSE_TIMEOUT_S)
+    # chaos-plane phase (deterministic scheduled faults): identical
+    # (schedule, seed) -> identical fault trace, the exhaustive
+    # crash-point sweep over every WAL/checkpoint write boundary with
+    # recovery + clean invariants at each, and the combined
+    # async+defense+registry world under scripted multi-layer faults
+    _run_demoted_phase("chaosplan", _CHAOSPLAN_TIMEOUT_S)
     # planet phase (registry-backed population plane): 1M-registry /
     # 10k-cohort rounds with warm-run RSS deltas flat in registry
     # size, two-tier tree aggregation bit-identical to flat, and the
@@ -2860,6 +3334,8 @@ def _phase_main(argv) -> None:
         out = run_straggler(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "defense":
         out = run_defense(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "chaosplan":
+        out = run_chaosplan(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "planet":
         out = run_planet(on_cpu=a.cpu, smoke=a.smoke)
     else:

@@ -81,6 +81,20 @@ python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_defense_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
 
+# Chaos-plane smoke (determinism pair + exhaustive crash-point sweep +
+# combined async/defense/registry world, CPU): the deterministic chaos
+# plane must run end-to-end through bench.py's chaosplan phase child
+# and emit the detail.chaosplan contract keys — an identical
+# (ChaosSchedule, seed) pair reproducing the identical fault trace
+# (telemetry counters + chaos.fault trace events), the server killed
+# at EVERY enumerated WAL-append / checkpoint-publish write boundary
+# with recovery and a clean InvariantChecker at each crash point, and
+# the scripted-fault async world reaching its fold target with
+# exactly-once folds proven from artifacts.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_chaosplan_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider
+
 # Planet smoke (100k-client registry, 1k cohort x 3 rounds, CPU): the
 # planet-scale population plane must run end-to-end through bench.py's
 # planet phase child and emit the detail.planet contract keys —

@@ -132,6 +132,21 @@ _DEFAULTS: Dict[str, Any] = {
     # mapping of {drop_prob, duplicate_prob, delay_s, delay_prob, seed,
     # msg_types, max_faults}; None disables
     "fault_injection": None,
+    # deterministic chaos plane (core/chaos.py): an ordered list of
+    # one-shot fault steps {at: {event, occurrence, round?, rank?,
+    # msg_type?, name?}, fault: kind-or-mapping} driving exact-message
+    # comm faults, WAL/checkpoint IO faults (torn write, failed fsync,
+    # ENOSPC, latency, torn publish), process kills at named barriers
+    # and clock skew. None disables
+    "chaos_schedule": None,
+    # seed for any randomness a schedule step asks for (latency
+    # jitter); an identical (chaos_schedule, chaos_seed) pair
+    # reproduces the identical fault trace
+    "chaos_seed": 0,
+    # IO-only fault steps (same step shape, events wal_create /
+    # wal_append / ckpt_publish only) — convenience for faulting the
+    # durable-write seam without a full schedule. None disables
+    "io_faults": None,
     # reliable delivery (core/comm/reliable.py): wrap every comm
     # endpoint in an ack/retransmit channel with receive-side dedup —
     # effectively exactly-once delivery over a lossy network. Enable on
@@ -518,6 +533,28 @@ class Arguments:
                 "agg_mode=async has no round barrier; "
                 "aggregation_deadline_s does not apply — unset one of them"
             )
+        # -- chaos plane knobs (docs/robustness.md chaos schedule DSL) --
+        from .core.chaos import validate_schedule
+
+        validate_schedule(getattr(self, "chaos_schedule", None), "chaos_schedule")
+        io_steps = validate_schedule(getattr(self, "io_faults", None), "io_faults")
+        bad_io = [
+            s for s in io_steps
+            if s["at"]["event"] not in ("wal_create", "wal_append", "ckpt_publish")
+        ]
+        if bad_io:
+            raise ValueError(
+                f"io_faults only takes IO events (wal_create / wal_append / "
+                f"ckpt_publish); got {sorted(s['at']['event'] for s in bad_io)}"
+                " — use chaos_schedule for comm/barrier steps"
+            )
+        raw = getattr(self, "chaos_seed", 0)
+        try:
+            self.chaos_seed = int(raw or 0)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"chaos_seed={raw!r}: must be an integer"
+            ) from None
         # -- defense / attack knobs (docs/robustness.md threat model) --
         defense = getattr(self, "defense_type", None) or None
         if defense is not None and defense not in constants.DEFENSE_TYPES:

@@ -23,6 +23,13 @@ dependency):
   per-rank clock skew corrected) and run the round critical-path
   analyzer — ``trace_merged.json`` + ``round_report.json``
   (``core/tracing.py``, docs/observability.md).
+- ``check``    — beyond the reference: replay a finished run's
+  artifacts (``round_wal.jsonl`` + ``telemetry.jsonl`` +
+  ``trace.json``) through the post-hoc ``InvariantChecker``
+  (``core/invariants.py``) — exactly-once folds, model-version
+  monotonicity across restarts, quorum/cohort accounting, no reissued
+  dispatch seqs, no lost-but-unreported folds. Exit 0 = clean, 1 =
+  violations (printed as one JSON line).
 
 State lives under ``~/.fedml_tpu/`` (override: FEDML_TPU_HOME).
 """
@@ -266,6 +273,34 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Run the post-hoc invariant checker over a run's artifacts.
+
+    Prints one JSON line ``{ok, checked, skipped, violations}``;
+    exit code 1 when any invariant is violated (CI-gateable). The WAL
+    is read from ``--checkpoint-dir`` when the run kept its
+    checkpoints elsewhere than its telemetry."""
+    from .core.invariants import InvariantChecker
+
+    if not os.path.isdir(args.telemetry_dir):
+        print(f"check: {args.telemetry_dir!r} not found", file=sys.stderr)
+        return 2
+    report = InvariantChecker(
+        telemetry_dir=args.telemetry_dir,
+        checkpoint_dir=args.checkpoint_dir,
+    ).check()
+    out = report.to_dict()
+    print(json.dumps(out))
+    if not report.ok:
+        for v in report.violations:
+            print(
+                f"check: VIOLATED {v['invariant']}: {v['detail']}",
+                file=sys.stderr,
+            )
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fedml-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -310,6 +345,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="also print a per-round segment table to stderr",
     )
     trace.set_defaults(fn=cmd_trace)
+
+    check = sub.add_parser("check")
+    check.add_argument(
+        "--telemetry-dir", required=True,
+        help="directory holding the run's telemetry.jsonl / trace.json",
+    )
+    check.add_argument(
+        "--checkpoint-dir", default=None,
+        help="directory holding round_wal.jsonl (default: the telemetry dir)",
+    )
+    check.set_defaults(fn=cmd_check)
 
     build = sub.add_parser("build")
     build.add_argument("-t", "--type", required=True, choices=["client", "server"])

@@ -20,6 +20,68 @@ import jax
 import numpy as np
 
 
+class DurableIO:
+    """The physical-write seam under every durable-state mutation:
+    round-WAL file creation, WAL appends, and orbax checkpoint
+    publishes all route their syscalls through the installed instance.
+
+    Default = real IO. The chaos plane (``core/chaos.py`` ``FaultyIO``)
+    installs one that can tear a write at byte K, fail an fsync, raise
+    ENOSPC, inject latency, corrupt a just-published checkpoint step,
+    or kill the "process" at an exact write boundary — which is what
+    makes the crash-point sweep enumerable instead of timing-based.
+    ``RecordingIO`` (also ``core/chaos.py``) uses the same seam to
+    enumerate every write boundary of a run.
+    """
+
+    def wal_create(self, dir_path: str, path: str) -> None:
+        """Create the WAL file AND fsync its parent directory: the file
+        data of the first append is fsynced by ``wal_append``, but the
+        directory ENTRY is its own durable object — a crash right after
+        create could otherwise lose the whole log to a journal replay
+        that never saw the dirent."""
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY, 0o644)
+        os.close(fd)
+        dfd = os.open(dir_path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def wal_append(self, path: str, data: bytes, **ctx) -> None:
+        """One durable append: write + flush + fsync. ``ctx`` carries
+        the record's identity (round_idx, kind) for fault targeting."""
+        with open(path, "ab") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def ckpt_publish(self, save_fn, step: int, dir_path: str) -> None:
+        """One checkpoint publish (orbax save + wait); ``save_fn`` does
+        the real work so a fault implementation can skip, delay, kill
+        around, or corrupt the published step."""
+        save_fn()
+
+
+_DEFAULT_IO = DurableIO()
+_CURRENT_IO: DurableIO = _DEFAULT_IO
+
+
+def install_io_seam(seam: DurableIO) -> None:
+    """Install a process-wide IO seam (chaos plane / tests)."""
+    global _CURRENT_IO
+    _CURRENT_IO = seam
+
+
+def reset_io_seam() -> None:
+    global _CURRENT_IO
+    _CURRENT_IO = _DEFAULT_IO
+
+
+def current_io() -> DurableIO:
+    return _CURRENT_IO
+
+
 class RoundCheckpointer:
     """Saves {params, server_state, rng, round_idx} every
     ``checkpoint_freq`` rounds under ``checkpoint_dir``.
@@ -50,10 +112,16 @@ class RoundCheckpointer:
             # single-controller: host copies decouple the checkpoint
             # from donated device buffers
             state = jax.tree.map(np.asarray, state)
-        self.manager.save(
-            round_idx, args=self._ocp.args.StandardSave(state)
-        )
-        self.manager.wait_until_finished()
+
+        def _publish() -> None:
+            self.manager.save(
+                round_idx, args=self._ocp.args.StandardSave(state)
+            )
+            self.manager.wait_until_finished()
+
+        # publishes route through the durable-IO seam so the chaos
+        # plane can kill/corrupt/delay at this exact write boundary
+        current_io().ckpt_publish(_publish, step=round_idx, dir_path=self.dir)
         logging.info("checkpoint saved at round %d -> %s", round_idx, self.dir)
 
     def latest_step(self) -> Optional[int]:
@@ -141,9 +209,12 @@ class RoundWAL:
       ``(rank, seq)`` pairs per publish plus the dispatch-sequence
       high-water mark the resumed server must not reuse.
 
-    Durability: each append is one ``write + flush + fsync``; ``last``
-    / ``records`` tolerate a torn final line (a server killed
-    mid-append is a normal event this log exists for).
+    Durability: each append is one ``write + flush + fsync`` (through
+    the ``DurableIO`` seam, so the chaos plane can fault it); the
+    FIRST append also fsyncs the parent directory — the dirent of a
+    just-created log is its own durable object. ``last`` / ``records``
+    tolerate a torn final line (a server killed mid-append is a normal
+    event this log exists for).
     """
 
     FILENAME = "round_wal.jsonl"
@@ -184,6 +255,7 @@ class RoundWAL:
         # final line; start fresh so the new record never concatenates
         # onto it (the torn fragment stays skippable on read)
         torn_tail = False
+        created = False
         if not self._tail_checked:
             try:
                 with open(self.path, "rb") as f:
@@ -192,11 +264,17 @@ class RoundWAL:
                         f.seek(-1, os.SEEK_END)
                         torn_tail = f.read(1) != b"\n"
             except FileNotFoundError:
-                pass
-        with open(self.path, "a") as f:
-            f.write(("\n" if torn_tail else "") + json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+                created = True
+        io = current_io()
+        if created:
+            # first append of this log's life: the directory entry is
+            # its own durable object (fsynced by the seam) — file-data
+            # fsyncs alone can lose a freshly-created file to a crash
+            io.wal_create(self.dir, self.path)
+        data = (("\n" if torn_tail else "") + json.dumps(rec) + "\n").encode()
+        io.wal_append(
+            self.path, data, round_idx=int(round_idx), kind=kind
+        )
         self._tail_checked = True
 
     def records(self) -> List[Dict[str, Any]]:

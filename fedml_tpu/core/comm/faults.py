@@ -64,8 +64,17 @@ class FaultInjector(BaseCommunicationManager):
         seed: int = 0,
         msg_types=None,
         max_faults: Optional[int] = None,
+        plan=None,
     ) -> None:
         self.inner = inner
+        # deterministic plan seam (core/chaos.py comm_plan): consulted
+        # BEFORE the probability rolls — a ChaosSchedule step names the
+        # exact Nth matching message to drop/duplicate/delay, so chaos
+        # worlds reproduce the identical fault trace per (schedule,
+        # seed). Scheduled faults ignore msg_types/max_faults (they are
+        # explicit, one-shot decisions, not a rate) and compose with
+        # the probabilistic knobs for unmatched messages.
+        self.plan = plan
         self.drop_prob = float(drop_prob)
         self.duplicate_prob = float(duplicate_prob)
         self.delay_s = float(delay_s)
@@ -107,7 +116,73 @@ class FaultInjector(BaseCommunicationManager):
             return False
         return True
 
+    def _apply_scheduled(self, msg: Message, fault: dict) -> bool:
+        """One scheduled (exact-message) fault; True when the send was
+        consumed here. Counted ONLY by the schedule
+        (chaos_faults_injected_total) — never via ``_note_fault``: the
+        probabilistic ``injected`` tally feeds ``_armed``'s max_faults
+        budget and ``comm_faults_injected_total``, and a scheduled
+        one-shot must neither spend that budget nor inflate the series
+        existing worlds assert against."""
+        kind = fault.get("kind")
+        if kind == "drop":
+            logging.warning(
+                "chaos: scheduled DROP msg type %s %d->%d",
+                msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
+            )
+            return True
+        if kind == "duplicate":
+            logging.warning(
+                "chaos: scheduled DUPLICATE msg type %s %d->%d",
+                msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
+            )
+            self.inner.send_message(msg)
+            self.inner.send_message(msg)
+            return True
+        if kind == "delay":
+            # an EXPLICIT delay_s (including 0 — a pure timer-hop
+            # reorder probe) is honored verbatim; only an absent key
+            # falls back to the injector's knob, then to 50ms
+            if "delay_s" in fault:
+                delay_s = float(fault["delay_s"])
+            else:
+                delay_s = float(self.delay_s or 0.05)
+            logging.warning(
+                "chaos: scheduled DELAY %.2fs msg type %s %d->%d",
+                delay_s, msg.get_type(),
+                msg.get_sender_id(), msg.get_receiver_id(),
+            )
+            self._deliver_delayed(msg, delay_s)
+            return True
+        return False
+
+    def _deliver_delayed(self, msg: Message, delay_s: float) -> None:
+        t_ref = []
+
+        def fire() -> None:
+            # drop our own reference when done: each Timer holds its
+            # Message (full model params), so an append-only list grows
+            # by one payload per injected delay
+            try:
+                if not self.closed:
+                    self.inner.send_message(msg)
+            finally:
+                try:
+                    self._timers.remove(t_ref[0])
+                except ValueError:
+                    pass
+
+        t = threading.Timer(delay_s, fire)
+        t_ref.append(t)
+        t.daemon = True
+        self._timers.append(t)
+        t.start()
+
     def send_message(self, msg: Message) -> None:
+        if self.plan is not None:
+            fault = self.plan(msg)
+            if fault and self._apply_scheduled(msg, fault):
+                return
         if self._armed(msg):
             roll = self._rng.random_sample()
             if roll < self.drop_prob:
@@ -133,26 +208,7 @@ class FaultInjector(BaseCommunicationManager):
                     self.delay_s, msg.get_type(),
                     msg.get_sender_id(), msg.get_receiver_id(),
                 )
-                t_ref = []
-
-                def fire() -> None:
-                    # drop our own reference when done: each Timer holds
-                    # its Message (full model params), so an append-only
-                    # list grows by one payload per injected delay
-                    try:
-                        if not self.closed:
-                            self.inner.send_message(msg)
-                    finally:
-                        try:
-                            self._timers.remove(t_ref[0])
-                        except ValueError:
-                            pass
-
-                t = threading.Timer(self.delay_s, fire)
-                t_ref.append(t)
-                t.daemon = True
-                self._timers.append(t)
-                t.start()
+                self._deliver_delayed(msg, self.delay_s)
                 return
         self.inner.send_message(msg)
 
@@ -191,9 +247,16 @@ def maybe_wrap_faulty(com: BaseCommunicationManager, args) -> BaseCommunicationM
     deterministic while decorrelating streams across the world.
     """
     spec = getattr(args, "fault_injection", None)
-    if not spec:
+    rank = int(getattr(args, "rank", 0))
+    # the deterministic chaos plan (core/chaos.py): an installed
+    # ChaosSchedule with send steps wraps the injector even with no
+    # probabilistic knobs, so scheduled exact-message faults work alone
+    from ..chaos import comm_plan
+
+    plan = comm_plan(rank)
+    if not spec and plan is None:
         return com
-    if not isinstance(spec, dict):
+    if spec and not isinstance(spec, dict):
         raise ValueError(
             f"fault_injection must be a mapping of knobs, got {type(spec).__name__}"
         )
@@ -201,10 +264,9 @@ def maybe_wrap_faulty(com: BaseCommunicationManager, args) -> BaseCommunicationM
         "drop_prob", "duplicate_prob", "delay_s", "delay_prob",
         "seed", "msg_types", "max_faults",
     }
+    spec = dict(spec or {})
     unknown = set(spec) - allowed
     if unknown:
         raise ValueError(f"unknown fault_injection keys: {sorted(unknown)}")
-    spec = dict(spec)
-    rank = int(getattr(args, "rank", 0))
     spec["seed"] = (int(spec.get("seed", 0)) + 0x9E3779B1 * (rank + 1)) % (2**32)
-    return FaultInjector(com, **spec)
+    return FaultInjector(com, plan=plan, **spec)

@@ -168,6 +168,20 @@ class ReliableChannel(BaseCommunicationManager):
             self._COUNTER_NAMES[kind], msg_type=int(msg_type)
         )
 
+    def _note_internal_error(self, site: str, exc: BaseException) -> None:
+        """An exception the channel absorbs by design (the retransmit
+        timer / dedup+re-ack path IS the recovery) — but never
+        silently: counted per site so a chaos run cannot hide a channel
+        bug behind its injected faults, and debug-logged with the
+        traceback."""
+        from ..telemetry import Telemetry
+
+        Telemetry.get_instance().inc("comm_internal_errors_total", site=site)
+        logging.debug(
+            "reliable: internal error at %s: %s: %s",
+            site, type(exc).__name__, exc, exc_info=True,
+        )
+
     def pending_unacked(self) -> int:
         with self._lock:
             return len(self._pending)
@@ -195,9 +209,10 @@ class ReliableChannel(BaseCommunicationManager):
         msg.add_params(constants.MSG_ARG_KEY_COMM_CHAN, self.channel_id)
         try:
             self.inner.send_message(msg)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — retransmit timer is the retry
             # transient transport failure: the retransmit timer IS the
-            # retry path — log and let backoff take it from here
+            # retry path — count + log and let backoff take it from here
+            self._note_internal_error("initial_send", e)
             logging.warning(
                 "reliable: initial send of seq %d failed; will retransmit",
                 seq, exc_info=True,
@@ -260,7 +275,8 @@ class ReliableChannel(BaseCommunicationManager):
         )
         try:
             self.inner.send_message(msg)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — backoff re-schedules below
+            self._note_internal_error("retransmit", e)
             logging.warning(
                 "reliable: retransmit of seq %d failed; backing off",
                 seq, exc_info=True,
@@ -304,10 +320,11 @@ class ReliableChannel(BaseCommunicationManager):
             ack.add_params(constants.MSG_ARG_KEY_COMM_ACK_CHAN, chan)
             try:
                 self.inner.send_message(ack)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — sender retransmits, we re-ack
                 # a lost ACK is recoverable by design: the sender
-                # retransmits and we dedup + re-ACK
-                logging.debug("reliable: ack send to rank %d failed", sender)
+                # retransmits and we dedup + re-ACK — but count it, so
+                # an ack path that fails every time is visible
+                self._note_internal_error("ack_send", e)
 
     def _is_duplicate(self, sender: int, chan: int, seq: int) -> bool:
         with self._lock:

@@ -1,0 +1,644 @@
+"""Post-hoc invariant checking over a finished run's artifacts.
+
+The exactly-once / recovery guarantees PRs 5-8 added were each
+re-asserted by hand inside the bench world that introduced them. This
+module is the ONE reusable checker: it replays a run's durable
+artifacts — ``round_wal.jsonl`` (the server's completed-round /
+publish ledger, ``core/checkpoint.py``), ``telemetry.jsonl`` (final
+counter snapshots, ``core/telemetry.py``) and ``trace.json`` (the
+flight record) — and verifies the federation's safety invariants from
+evidence, not from in-process state:
+
+======================  =======================  =========================
+invariant               artifact source          checked against
+======================  =======================  =========================
+wal_well_formed         round_wal.jsonl          record schema
+cohort_accounting       round_wal.jsonl          folded ⊆ cohort, no dup rank
+partial_closes_
+  accounted             round_wal + telemetry    quorum/deadline/death/leave/
+                                                 quarantine counters
+round_monotone          round_wal.jsonl          backward jumps land on a
+                                                 durable ckpt_step
+ckpt_step_monotone      round_wal.jsonl          non-decreasing steps
+version_monotone        round_wal.jsonl          async publish versions
+                                                 strictly increasing
+no_reissued_seqs        round_wal.jsonl          max_seq non-decreasing;
+                                                 pair seq <= its record's
+exactly_once_folds      round_wal.jsonl          (rank, seq) pairs globally
+                                                 distinct; whole-record
+                                                 re-carries allowed up to the
+                                                 counted append failures
+fold_ledger_consistent  round_wal.jsonl          folds_total covers the
+                                                 cumulative pair count
+ledger_counter_match    round_wal + telemetry    wal_rounds/folds_logged_total
+                                                 == records (± crashes +
+                                                 append failures)
+published_counter_match round_wal + telemetry    agg_folds_published_total
+                                                 == distinct pairs (± crashes
+                                                 + append failures)
+no_lost_unreported      telemetry.jsonl          folds accepted - published
+  _folds                                         == reported lost (clean
+                                                 finish only)
+counters_cover_ledger   round_wal + telemetry    agg_folds_total >= ledger
+chaos_trace_consistent  trace.json + telemetry   chaos.fault instants ==
+                                                 chaos_faults_injected_total
+======================  =======================  =========================
+
+Counter-based invariants read the final snapshot per rank; in a LOCAL
+world (one shared registry across server incarnations) they are exact.
+A multi-process run whose server restarted resets its counters — that
+reset is detected from the artifacts themselves (counters are
+monotonic, so ANY decrease across a rank's successive snapshots proves
+a registry reset) and every counter-balanced invariant is then skipped
+(noted in the report), while the WAL-internal invariants always apply.
+
+Exposed as ``fedml_tpu.cli check --telemetry-dir`` and run
+automatically at the end of every chaos / straggler / defense /
+chaosplan bench world.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["InvariantChecker", "InvariantReport"]
+
+
+class InvariantReport:
+    """Outcome of one check run: which invariants were checked, which
+    were skipped (artifact missing / not applicable) and every
+    violation found, most severe first in insertion order."""
+
+    def __init__(self) -> None:
+        self.checked: List[str] = []
+        self.skipped: Dict[str, str] = {}
+        self.violations: List[Dict[str, Any]] = []
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def note_checked(self, name: str) -> None:
+        if name not in self.checked:
+            self.checked.append(name)
+
+    def skip(self, name: str, why: str) -> None:
+        self.skipped[name] = why
+
+    def fail(self, name: str, detail: str, **ctx: Any) -> None:
+        self.note_checked(name)
+        self.violations.append({"invariant": name, "detail": detail, **ctx})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "checked": list(self.checked),
+            "skipped": dict(self.skipped),
+            "violations": list(self.violations),
+        }
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                # torn final line: same tolerance as RoundWAL.records
+                logging.warning(
+                    "invariants: skipping torn line in %s: %r", path, line[:80]
+                )
+    return out
+
+
+def _counter_total(counters: Dict[str, float], name: str) -> float:
+    """Sum every tag-series of one counter from a snapshot's rendered
+    ``name{k=v}`` keys."""
+    total = 0.0
+    for key, v in counters.items():
+        if key == name or key.startswith(name + "{"):
+            total += float(v)
+    return total
+
+
+def _counter_tagged(
+    counters: Dict[str, float], name: str, tag: str, values
+) -> float:
+    """Sum the series of one counter whose rendered ``tag=value`` is in
+    ``values`` (tags render sorted, ``name{k=v,k2=v2}``)."""
+    total = 0.0
+    prefix = name + "{"
+    for key, v in counters.items():
+        if not key.startswith(prefix) or not key.endswith("}"):
+            continue
+        tags = dict(
+            kv.split("=", 1)
+            for kv in key[len(prefix):-1].split(",")
+            if "=" in kv
+        )
+        if tags.get(tag) in values:
+            total += float(v)
+    return total
+
+
+class InvariantChecker:
+    """Replay a run's artifacts and verify the safety invariants.
+
+    ``telemetry_dir`` holds ``telemetry.jsonl`` / ``trace*.json``;
+    ``checkpoint_dir`` holds ``round_wal.jsonl`` (defaults to the
+    telemetry dir — a world that points both at the same directory
+    needs only one argument).
+    """
+
+    def __init__(
+        self,
+        telemetry_dir: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> None:
+        self.telemetry_dir = telemetry_dir
+        self.checkpoint_dir = checkpoint_dir or telemetry_dir
+        self.wal_records: List[dict] = []
+        self.wal_path: Optional[str] = None
+        self.counters: Dict[str, float] = {}
+        self.counters_reset = False
+        self.snapshots: List[dict] = []
+        self.trace_events: List[dict] = []
+        self._load()
+
+    # -- artifact loading ---------------------------------------------
+    def _load(self) -> None:
+        from .checkpoint import RoundWAL
+
+        if self.checkpoint_dir:
+            path = os.path.join(self.checkpoint_dir, RoundWAL.FILENAME)
+            if os.path.exists(path):
+                self.wal_path = path
+                self.wal_records = RoundWAL(self.checkpoint_dir).records()
+        if self.telemetry_dir:
+            tpath = os.path.join(self.telemetry_dir, "telemetry.jsonl")
+            if os.path.exists(tpath):
+                self.snapshots = _load_jsonl(tpath)
+                # final snapshot per rank; counters summed across ranks
+                # (fold/ledger counters only exist on the server, so
+                # the sum is the server's final view). Counters are
+                # monotonic by construction, so ANY decrease across a
+                # rank's successive snapshots proves its registry was
+                # reset (a multi-process server restart) — the final
+                # snapshot then under-counts the run and every
+                # counter-balanced invariant must be skipped, not
+                # failed.
+                last_by_rank: Dict[Any, dict] = {}
+                for snap in self.snapshots:
+                    rank = snap.get("rank", 0)
+                    cur = snap.get("counters") or {}
+                    prev = (last_by_rank.get(rank) or {}).get("counters") or {}
+                    for k, v in cur.items():
+                        if k in prev and float(v) < float(prev[k]) - 1e-9:
+                            self.counters_reset = True
+                    last_by_rank[rank] = snap
+                for snap in last_by_rank.values():
+                    for k, v in (snap.get("counters") or {}).items():
+                        self.counters[k] = self.counters.get(k, 0.0) + float(v)
+            for name in ("trace.json",):
+                path = os.path.join(self.telemetry_dir, name)
+                if os.path.exists(path):
+                    try:
+                        with open(path) as f:
+                            self.trace_events.extend(
+                                json.load(f).get("traceEvents") or []
+                            )
+                    except ValueError:
+                        logging.warning("invariants: unreadable %s", path)
+
+    def _ctr(self, name: str) -> float:
+        return _counter_total(self.counters, name)
+
+    # -- the check ----------------------------------------------------
+    def check(self) -> InvariantReport:
+        rep = InvariantReport()
+        sync = [r for r in self.wal_records if r.get("kind") != "publish"]
+        publishes = [r for r in self.wal_records if r.get("kind") == "publish"]
+        if not self.wal_records:
+            rep.skip("wal_well_formed", "no round_wal.jsonl found")
+        else:
+            self._check_wal_shape(rep, sync, publishes)
+            self._check_cohorts(rep, sync)
+            self._check_round_monotone(rep, sync)
+            self._check_async(rep, publishes)
+        self._check_counters(rep, sync, publishes)
+        self._check_chaos_trace(rep)
+        return rep
+
+    # -- WAL-internal invariants --------------------------------------
+    def _check_wal_shape(self, rep, sync, publishes) -> None:
+        rep.note_checked("wal_well_formed")
+        for i, rec in enumerate(self.wal_records):
+            if not isinstance(rec.get("round_idx"), int):
+                rep.fail(
+                    "wal_well_formed", f"record {i} has no round_idx", rec=rec
+                )
+            cohort = rec.get("cohort")
+            if not isinstance(cohort, list):
+                rep.fail(
+                    "wal_well_formed", f"record {i} has no cohort list", rec=rec
+                )
+
+    def _check_cohorts(self, rep, sync) -> None:
+        rep.note_checked("cohort_accounting")
+        partial = 0
+        for i, rec in enumerate(sync):
+            cohort = set(rec.get("cohort") or [])
+            folded = rec.get("folded")
+            if folded is None:
+                continue
+            if len(folded) != len(set(folded)):
+                rep.fail(
+                    "cohort_accounting",
+                    f"sync record {i} (round {rec['round_idx']}) folds a "
+                    "rank twice",
+                    folded=folded,
+                )
+            extra = set(folded) - cohort
+            if extra:
+                rep.fail(
+                    "cohort_accounting",
+                    f"sync record {i} (round {rec['round_idx']}) folded "
+                    f"ranks {sorted(extra)} outside its cohort",
+                    cohort=sorted(cohort),
+                )
+            if len(set(folded)) < len(cohort):
+                partial += 1
+        # partial closes need an explanation in the counters: quorum
+        # grace, deadline drop, declared death, elastic leave or
+        # quarantine — a silently shrunken round is a lost-fold bug
+        if partial:
+            if not self.counters:
+                rep.skip(
+                    "partial_closes_accounted", "no telemetry.jsonl found"
+                )
+                return
+            if self.counters_reset:
+                rep.skip(
+                    "partial_closes_accounted",
+                    "counters reset by a server restart; evidence may "
+                    "predate the final snapshot",
+                )
+                return
+            rep.note_checked("partial_closes_accounted")
+            explained = (
+                self._ctr("agg_quorum_closes_total")
+                + self._ctr("cross_silo_clients_declared_dead_total")
+                + self._ctr("cross_silo_client_leaves_total")
+                + self._ctr("cross_silo_stragglers_dropped_total")
+                + self._ctr("defense_quarantined_total")
+            )
+            # gauge fallback: stragglers_dropped predates the counter
+            explained += _counter_total(
+                self.counters, "cross_silo_stragglers_dropped"
+            )
+            if explained <= 0:
+                rep.fail(
+                    "partial_closes_accounted",
+                    f"{partial} round(s) closed over a partial cohort with "
+                    "no quorum/deadline/death/leave/quarantine evidence in "
+                    "the counters",
+                    partial_rounds=partial,
+                )
+
+    def _check_round_monotone(self, rep, sync) -> None:
+        rep.note_checked("round_monotone")
+        rep.note_checked("ckpt_step_monotone")
+        durable_steps = set()
+        prev_round = None
+        prev_step = None
+        for i, rec in enumerate(sync):
+            r = int(rec["round_idx"])
+            step = rec.get("ckpt_step")
+            if prev_round is not None and r < prev_round:
+                # a backward jump is a resume: legal only onto a round
+                # some earlier checkpoint made durable
+                if r not in durable_steps:
+                    rep.fail(
+                        "round_monotone",
+                        f"sync record {i} jumps back to round {r} which no "
+                        "earlier checkpoint made durable "
+                        f"(durable steps: {sorted(durable_steps)})",
+                    )
+            prev_round = r
+            if step is not None:
+                if prev_step is not None and int(step) < prev_step:
+                    rep.fail(
+                        "ckpt_step_monotone",
+                        f"sync record {i} checkpoint step {step} < previous "
+                        f"{prev_step}",
+                    )
+                prev_step = int(step)
+                durable_steps.add(int(step))
+
+    def _check_async(self, rep, publishes) -> None:
+        if not publishes:
+            for name in (
+                "version_monotone", "no_reissued_seqs", "exactly_once_folds",
+                "fold_ledger_consistent",
+            ):
+                rep.skip(name, "no async publish records")
+            return
+        rep.note_checked("version_monotone")
+        rep.note_checked("no_reissued_seqs")
+        rep.note_checked("exactly_once_folds")
+        rep.note_checked("fold_ledger_consistent")
+        # a failed-but-durable append (fsync refused after the bytes
+        # landed) legitimately double-books: the server cannot know the
+        # record survived, so it re-carries the WHOLE record's folds
+        # into the next successful record (the write-ahead invariant
+        # demands it; the WAL stores fold sets sorted, so order carries
+        # no evidence). A legal carry therefore repeats exactly the
+        # preceding record's complete pair set, and the number of
+        # carrying records is bounded by the counted append failures —
+        # a partial repeat, or more carries than failures, is a real
+        # double-fold.
+        failures = self._ctr("wal_append_failures_total")
+        carry_records = 0
+        prev_version = None
+        prev_max_seq = None
+        prev_pairs: set = set()
+        seen_pairs = set()
+        for i, rec in enumerate(publishes):
+            version = int(rec.get("version", rec["round_idx"]))
+            if prev_version is not None and version <= prev_version:
+                rep.fail(
+                    "version_monotone",
+                    f"publish record {i} version {version} <= previous "
+                    f"{prev_version} — the model went backward",
+                )
+            prev_version = version
+            max_seq = int(rec.get("max_seq", 0))
+            if prev_max_seq is not None and max_seq < prev_max_seq:
+                rep.fail(
+                    "no_reissued_seqs",
+                    f"publish record {i} max_seq {max_seq} < previous "
+                    f"{prev_max_seq} — the dispatch high-water mark went "
+                    "backward",
+                )
+            prev_max_seq = max_seq
+            pairs = [
+                tuple(int(x) for x in p)
+                for p in (rec.get("folded") or [])
+                if isinstance(p, (list, tuple)) and len(p) == 2
+            ]
+            if len(pairs) != len(set(pairs)):
+                rep.fail(
+                    "exactly_once_folds",
+                    f"publish record {i} folds a (rank, seq) pair twice "
+                    "within one record",
+                )
+            repeated = {p for p in pairs if p in seen_pairs}
+            if repeated:
+                if repeated != prev_pairs:
+                    # a carry re-writes the preceding (failed) record
+                    # wholesale; repeating only SOME of it — or pairs
+                    # from older records — is a refold, not a carry
+                    rep.fail(
+                        "exactly_once_folds",
+                        f"publish record {i} re-folds {sorted(repeated)} "
+                        "which is not a whole-record carry of the "
+                        "preceding record — an upload entered the "
+                        "durable ledger twice",
+                    )
+                else:
+                    carry_records += 1
+            prev_pairs = set(pairs)
+            for rank, seq in pairs:
+                seen_pairs.add((rank, seq))
+                if seq > max_seq:
+                    rep.fail(
+                        "no_reissued_seqs",
+                        f"publish record {i} folds seq {seq} above its own "
+                        f"dispatch high-water mark {max_seq}",
+                    )
+            folds_total = int(rec.get("folds_total", 0))
+            if folds_total < len(seen_pairs):
+                rep.fail(
+                    "fold_ledger_consistent",
+                    f"publish record {i} claims {folds_total} total folds "
+                    f"but the ledger already holds {len(seen_pairs)} "
+                    "distinct pairs",
+                )
+        if carry_records > failures and self.counters and not self.counters_reset:
+            # with NO counters (telemetry disabled) or reset counters
+            # (multi-process restart) the failure count may
+            # under-report, so only the structural rules (whole-record
+            # carry, no partial repeats) apply — every other
+            # counter-balanced invariant skips in those cases too
+            rep.fail(
+                "exactly_once_folds",
+                f"{carry_records} publish record(s) re-carry earlier "
+                f"pairs but only {failures:g} WAL append failure(s) were "
+                "counted — an upload entered the durable ledger twice",
+            )
+
+    # -- counter cross-checks (telemetry.jsonl) -----------------------
+    def _check_counters(self, rep, sync, publishes) -> None:
+        names = (
+            "ledger_counter_match", "published_counter_match",
+            "no_lost_unreported_folds", "counters_cover_ledger",
+        )
+        if not self.counters:
+            for n in names:
+                rep.skip(n, "no telemetry.jsonl found")
+            return
+        if self.counters_reset:
+            # the docstring's promised tolerance: a multi-process
+            # restart reset the registry, so the final snapshot is
+            # plainly behind the WAL — the WAL-internal invariants
+            # still apply, the counter balances cannot
+            for n in names:
+                rep.skip(
+                    n,
+                    "counters reset by a server restart; the final "
+                    "snapshot under-counts the run",
+                )
+            return
+        # upper bounds on counter/ledger divergence: each injected
+        # CRASH (kill or torn write — not a delay, skew or refused
+        # fsync) can strand at most one durable record without its
+        # counter increment, and each counted append FAILURE may have
+        # left a durable record (fsync refused after the bytes landed)
+        # the counters never acknowledged. With neither, the gap must
+        # be exactly zero.
+        kills = _counter_tagged(
+            self.counters, "chaos_faults_injected_total",
+            "fault", ("kill_server", "kill_client", "torn_write"),
+        )
+        failures = self._ctr("wal_append_failures_total")
+        sync_with_folds = [r for r in sync if r.get("folded") is not None]
+        wal_sync_folds = sum(len(r["folded"]) for r in sync_with_folds)
+        logged_rounds = self._ctr("wal_rounds_logged_total")
+        logged_folds = self._ctr("wal_folds_logged_total")
+        if sync_with_folds and (logged_rounds or logged_folds):
+            rep.note_checked("ledger_counter_match")
+            rec_gap = len(sync_with_folds) - logged_rounds
+            fold_gap = wal_sync_folds - logged_folds
+            max_folds = max(
+                (len(r["folded"]) for r in sync_with_folds), default=0
+            )
+            if rec_gap < 0 or fold_gap < 0:
+                rep.fail(
+                    "ledger_counter_match",
+                    "the server counted more WAL appends than the log "
+                    "holds — records were lost after acknowledgement",
+                    records=len(sync_with_folds),
+                    counted=logged_rounds,
+                )
+            elif (
+                rec_gap > kills + failures
+                or fold_gap > (kills + failures) * max_folds
+            ):
+                rep.fail(
+                    "ledger_counter_match",
+                    f"{rec_gap:g} durable WAL record(s) / {fold_gap:g} "
+                    "fold(s) were never counted — beyond what "
+                    f"{kills:g} injected crash(es) and {failures:g} "
+                    "append failure(s) can explain",
+                )
+        elif sync_with_folds:
+            rep.skip("ledger_counter_match", "run predates the ledger counters")
+        pairs = set()
+        for rec in publishes:
+            for p in rec.get("folded") or []:
+                if isinstance(p, (list, tuple)) and len(p) == 2:
+                    pairs.add((int(p[0]), int(p[1])))
+        published_ctr = self._ctr("agg_folds_published_total")
+        if publishes and published_ctr:
+            rep.note_checked("published_counter_match")
+            gap = len(pairs) - published_ctr
+            max_pub_folds = max(
+                (
+                    len(rec.get("folded") or [])
+                    for rec in publishes
+                ),
+                default=0,
+            )
+            if gap < 0:
+                rep.fail(
+                    "published_counter_match",
+                    "more folds counted as published than the WAL ledger "
+                    "holds — the ledger under-covers the checkpoints",
+                    ledger=len(pairs),
+                    counted=published_ctr,
+                )
+            elif gap > (kills + failures) * max_pub_folds:
+                # a kill after the append — or a failed-but-durable
+                # final append — strands its whole record's pairs
+                # uncounted (a later success re-counts a carry), so
+                # each crash or failure explains up to one record's
+                # worth of pairs
+                rep.fail(
+                    "published_counter_match",
+                    f"{gap:g} ledgered fold(s) never counted as published "
+                    f"— beyond what {kills:g} injected crash(es) and "
+                    f"{failures:g} append failure(s) can explain",
+                )
+        elif publishes:
+            rep.skip(
+                "published_counter_match", "run predates the ledger counters"
+            )
+        # no-lost-unreported: only provable on a cleanly finished run
+        # (the finish path flushes every accepted fold to the ledger)
+        async_folds = _counter_total(self.counters, "agg_folds_total{mode=async}")
+        if publishes and async_folds:
+            if self._ctr("cross_silo_finish_total") < 1:
+                rep.skip(
+                    "no_lost_unreported_folds",
+                    "run did not finish cleanly; in-flight folds at the "
+                    "final crash are legitimately unaccounted",
+                )
+            else:
+                lost = self._ctr("agg_folds_lost_total")
+                unaccounted = async_folds - len(pairs) - lost
+                if unaccounted > 1e-9 and failures > 0:
+                    # a failed FINAL append (disk-full on the flush)
+                    # leaves accepted folds unledgered by the
+                    # documented degraded-durability contract — the
+                    # counted failures grant the same allowance the
+                    # ledger/published balances give
+                    rep.skip(
+                        "no_lost_unreported_folds",
+                        f"{failures:g} counted append failure(s) may have "
+                        f"left the {unaccounted:g} unledgered fold(s) "
+                        "behind (degraded durability, not a loss bug)",
+                    )
+                else:
+                    rep.note_checked("no_lost_unreported_folds")
+                    if abs(unaccounted) > 1e-9:
+                        rep.fail(
+                            "no_lost_unreported_folds",
+                            f"{unaccounted:g} accepted fold(s) neither "
+                            "reached the durable ledger nor were reported "
+                            f"lost (accepted {async_folds:g}, ledgered "
+                            f"{len(pairs)}, reported lost {lost:g})",
+                        )
+        total_ledger = wal_sync_folds + len(pairs)
+        folds_ctr = self._ctr("agg_folds_total")
+        if total_ledger and folds_ctr:
+            rep.note_checked("counters_cover_ledger")
+            if folds_ctr + 1e-9 < total_ledger:
+                rep.fail(
+                    "counters_cover_ledger",
+                    f"the durable ledger holds {total_ledger} fold(s) but "
+                    f"only {folds_ctr:g} were ever counted at fold time — "
+                    "either counters were reset (multi-process restart) or "
+                    "the ledger double-books",
+                )
+        elif total_ledger:
+            rep.skip("counters_cover_ledger", "no fold counters in snapshot")
+
+    # -- trace cross-check --------------------------------------------
+    def _check_chaos_trace(self, rep) -> None:
+        fault_ctr = self._ctr("chaos_faults_injected_total")
+        fault_events = [
+            e for e in self.trace_events if e.get("name") == "chaos.fault"
+        ]
+        if not fault_ctr and not fault_events:
+            rep.skip("chaos_trace_consistent", "no chaos faults in this run")
+            return
+        if not self.trace_events:
+            rep.skip("chaos_trace_consistent", "no trace.json found")
+            return
+        if self.counters_reset:
+            rep.skip(
+                "chaos_trace_consistent",
+                "counters reset by a server restart; the final snapshot "
+                "under-counts the injected faults",
+            )
+            return
+        rep.note_checked("chaos_trace_consistent")
+        if len(fault_events) != int(fault_ctr):
+            rep.fail(
+                "chaos_trace_consistent",
+                f"trace holds {len(fault_events)} chaos.fault instant(s) "
+                f"but counters say {fault_ctr:g} were injected — one "
+                "artifact lost fault evidence",
+            )
+
+    # -- convenience --------------------------------------------------
+    @staticmethod
+    def fault_signature(trace_events: List[dict]) -> List[tuple]:
+        """The determinism fingerprint of a run: its chaos.fault
+        instants as (fault, event) tuples, sorted — two runs of the
+        same (schedule, seed) must produce identical signatures."""
+        return sorted(
+            (
+                (e.get("args") or {}).get("fault"),
+                (e.get("args") or {}).get("event"),
+            )
+            for e in trace_events
+            if e.get("name") == "chaos.fault"
+        )

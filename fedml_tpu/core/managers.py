@@ -132,10 +132,18 @@ class _ManagerBase(Observer):
         self.com_manager = comm if comm is not None else _build_com_manager(
             args, rank, size, backend
         )
+        from .chaos import maybe_install_chaos
         from .comm.faults import maybe_wrap_faulty
         from .comm.instrument import wrap_instrumented
         from .comm.reliable import maybe_wrap_reliable
         from .telemetry import Telemetry
+
+        # deterministic chaos plane (core/chaos.py): installed BEFORE
+        # the comm stack is wrapped so maybe_wrap_faulty can pick up
+        # the schedule's send plan; also arms the durable-IO seam the
+        # WAL/checkpoint writes route through. No-op without the
+        # chaos_schedule / io_faults knobs.
+        maybe_install_chaos(args)
 
         # telemetry counting sits INSIDE fault injection: the counters
         # record actual wire traffic (a dropped message never left, a

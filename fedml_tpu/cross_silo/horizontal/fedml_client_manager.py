@@ -218,6 +218,23 @@ class FedMLClientManager(ClientManager):
     def _train_and_send(self, msg: Message) -> None:
         import time as _time
 
+        from ...core.chaos import ProcessKilled, chaos_barrier
+
+        try:
+            # named chaos barrier: a scheduled kill_client here is the
+            # kill -9 analog the chaos worlds choreograph by hand —
+            # the beat thread dies with the "process" (a corpse that
+            # kept beating would defeat the failure detector)
+            chaos_barrier(
+                "client.train",
+                round=int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0)),
+                rank=self.rank,
+            )
+        except ProcessKilled:
+            if self._heartbeat is not None:
+                self._heartbeat.stop()
+                self._heartbeat = None
+            raise
         params = msg.get(constants.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg.get(constants.MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = int(msg.get(constants.MSG_ARG_KEY_ROUND_INDEX, 0))

@@ -104,6 +104,7 @@ import logging
 from typing import Dict
 
 from ... import constants
+from ...core.chaos import chaos_barrier
 from ...core.managers import ServerManager
 from ...core.message import Message
 
@@ -430,6 +431,9 @@ class FedMLServerManager(ServerManager):
             if self._failure_detector is not None:
                 self._failure_detector.unwatch(sender)
             self.leaves += 1
+            # counted so the invariant checker can account a partial
+            # round close to a voluntary leave from artifacts alone
+            self.telemetry.inc("cross_silo_client_leaves_total")
             logging.info(
                 "elastic leave: rank %d offline at round %d", sender, self.round_idx
             )
@@ -711,6 +715,9 @@ class FedMLServerManager(ServerManager):
                 and self._rank_of_real_id[rid] not in quarantined
             ]
             n_select = len(candidate_ids)
+        # named chaos barrier: a scheduled kill_server here models a
+        # death between round close and the next broadcast
+        chaos_barrier("server.broadcast", round=self.round_idx, rank=self.rank)
         selected_real_ids = self.aggregator.client_selection(
             self.round_idx, candidate_ids, n_select
         )
@@ -947,6 +954,17 @@ class FedMLServerManager(ServerManager):
                     return
                 self._maybe_arm_quorum()
             return
+        # post-restart in-flight uploads: the PREVIOUS incarnation
+        # broadcast this round, so a just-restarted server can receive
+        # (and fold) round-tagged uploads before it ever re-broadcasts.
+        # Record the sender into the round's cohort — the WAL's
+        # folded ⊆ cohort invariant is about membership, not about
+        # which incarnation did the broadcasting. Recorded only once
+        # the upload is ACCEPTED (past the payload and quarantine
+        # rejections): a rejected sender must stay resync-eligible,
+        # and a silo of -1 here is safe because the accept sets the
+        # rank's uploaded flag, which short-circuits _maybe_resync
+        self._round_assignment.setdefault(sender_rank, -1)
         if not self._wait_open:
             self.profiler.log_event_started("server.wait")
             self._wait_open = True
@@ -1078,6 +1096,10 @@ class FedMLServerManager(ServerManager):
         # guarantees none of them is ever reissued
         self._dispatch_seq = (self._dispatch_seq // _SEQ_EPOCH + 1) * _SEQ_EPOCH
         if lost_folds:
+            # reported-lost counter: the InvariantChecker's
+            # "no lost-but-unreported folds" invariant balances
+            # accepted folds against ledgered + reported-lost
+            self.telemetry.inc("agg_folds_lost_total", len(lost_folds))
             logging.warning(
                 "async resume: %d fold(s) %s from publish(es) > version %d "
                 "were write-ahead logged but their checkpoint never landed "
@@ -1242,6 +1264,7 @@ class FedMLServerManager(ServerManager):
         folded = self._folded_since_publish
         if not folded:
             return
+        chaos_barrier("server.publish", round=self.version, rank=self.rank)
         with self.profiler.span("async_publish", version=self.version + 1):
             self.aggregator.publish_async()
         self.version += 1
@@ -1256,6 +1279,7 @@ class FedMLServerManager(ServerManager):
         ckpt_due = self._ckpt is not None
         if self._wal is not None:
             try:
+                written = self._unwaled_folds + folded
                 self._wal.append(
                     self.version,
                     self.version if ckpt_due else None,
@@ -1263,7 +1287,7 @@ class FedMLServerManager(ServerManager):
                     # include any folds orphaned by an earlier failed
                     # append: the ledger must cover everything the
                     # about-to-be-checkpointed params contain
-                    folded=self._unwaled_folds + folded,
+                    folded=written,
                     kind="publish",
                     extra={
                         "version": self.version,
@@ -1272,6 +1296,11 @@ class FedMLServerManager(ServerManager):
                     },
                 )
                 self._unwaled_folds = []
+                # durable-ledger counter: folds that reached the WAL —
+                # the InvariantChecker's "WAL ledger == fold counters"
+                # evidence (incremented only on a successful append, so
+                # it can never over-count the log)
+                self.telemetry.inc("agg_folds_published_total", len(written))
             except OSError:
                 # write-ahead invariant: the ledger must cover every
                 # fold a checkpoint might contain. If the WAL cannot be
@@ -1285,6 +1314,12 @@ class FedMLServerManager(ServerManager):
                     "checkpoint (durability degraded until the WAL "
                     "recovers)", self.version,
                 )
+                # counted as InvariantChecker evidence: a failed append
+                # whose bytes nonetheless landed (fsync refused) leaves
+                # a durable record the counters never acknowledged, and
+                # its folds re-appear carried in the next successful
+                # record — both gaps are bounded by this counter
+                self.telemetry.inc("wal_append_failures_total")
                 self._unwaled_folds.extend(folded)
                 ckpt_due = False
         if ckpt_due:
@@ -1320,6 +1355,7 @@ class FedMLServerManager(ServerManager):
     def _finish_round(self) -> None:
         """Aggregate whatever was received, eval, advance (shared by
         the all-received, deadline and quorum-grace paths)."""
+        chaos_barrier("server.round_close", round=self.round_idx, rank=self.rank)
         self._cancel_deadline()
         self._cancel_quorum()
         self._empty_deadline_fires = 0
@@ -1486,10 +1522,23 @@ class FedMLServerManager(ServerManager):
                 cohort_ranks,
                 folded=folded_ranks,
             )
+            # durable-ledger counters (InvariantChecker evidence): one
+            # round record and its fold count, bumped ONLY after the
+            # append returned — a crash at the write boundary leaves at
+            # most the final record unaccounted, which the checker
+            # bounds by the injected-crash count
+            self.telemetry.inc("wal_rounds_logged_total")
+            self.telemetry.inc(
+                "wal_folds_logged_total", len(folded_ranks or [])
+            )
         except OSError:
             # the WAL is an aid to recovery, never a reason to kill a
             # healthy federation (disk-full on the log must not)
             logging.exception("round WAL append failed for round %d", eval_round)
+            # InvariantChecker evidence: a refused fsync can leave a
+            # durable record the ledger counters never acknowledged —
+            # this bounds that counter/ledger gap from artifacts alone
+            self.telemetry.inc("wal_append_failures_total")
 
     def _report_round(self, round_idx: int, cohort: int, n_aggregated: int) -> None:
         self.metrics_reporter.report(
@@ -1509,6 +1558,10 @@ class FedMLServerManager(ServerManager):
             )
 
     def send_finish(self) -> None:
+        # clean-finish marker: tells the post-hoc InvariantChecker the
+        # final incarnation flushed its state (counter-vs-ledger
+        # equality is only provable on a cleanly finished run)
+        self.telemetry.inc("cross_silo_finish_total")
         for rank in range(1, len(self.client_real_ids) + 1):
             self.send_message(
                 Message(constants.MSG_TYPE_S2C_FINISH, self.rank, rank)

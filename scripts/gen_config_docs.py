@@ -184,7 +184,7 @@ GROUPS = [
         "grpc_ipconfig_path", "grpc_port_base", "fault_injection",
         "reliable_comm", "comm_retry_max", "comm_retry_base_s",
         "grpc_send_timeout_s", "heartbeat_interval_s", "heartbeat_timeout_s",
-        "round_deadline_s",
+        "round_deadline_s", "chaos_schedule", "chaos_seed", "io_faults",
     ]),
     ("Defense & attack synthesis", [
         "defense_type", "norm_bound", "stddev",

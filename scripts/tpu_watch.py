@@ -111,6 +111,7 @@ PHASES = [
     ("serving", ["--phase", "serving"], 300.0),
     ("tracing", ["--phase", "tracing"], 300.0),
     ("defense", ["--phase", "defense"], 420.0),
+    ("chaosplan", ["--phase", "chaosplan"], 480.0),
     ("planet", ["--phase", "planet"], 480.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window

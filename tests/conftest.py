@@ -29,12 +29,15 @@ def _reset_observability_singletons():
     reset, one test's args (or counters, heartbeats, watchdog) leak
     into every later test in the worker."""
     yield
+    from fedml_tpu.core.chaos import reset_chaos
     from fedml_tpu.core.telemetry import Telemetry
     from fedml_tpu.core.tracking import ProfilerEvent, RunLogger
 
     Telemetry.reset()
     ProfilerEvent.reset()
     RunLogger.reset()
+    # the chaos plane (schedule + durable-IO seam) is process-global
+    reset_chaos()
 
 
 @pytest.fixture(scope="session")

@@ -123,6 +123,20 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"defense"' in parent or "'defense'" in parent
 
+    def test_chaosplan_phase_contract(self):
+        """detail.chaosplan ships the deterministic chaos-plane
+        evidence (identical fault trace per (schedule, seed), the
+        exhaustive crash-point sweep with recovery + clean invariants
+        at every WAL/checkpoint write boundary, the combined
+        async+defense+registry world under scripted faults): the phase
+        is in the child vocabulary and the parent stitches it (like
+        defense, it runs demoted on the CPU fallback)."""
+        assert "chaosplan" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"chaosplan"' in parent or "'chaosplan'" in parent
+
     def test_planet_phase_contract(self):
         """detail.planet ships the planet-scale population evidence
         (registry-backed rounds/s, warm-run RSS flat in registry size,
@@ -259,6 +273,9 @@ class TestPhaseChild:
         assert d["exactly_once"] is True
         assert d["max_abs_diff_vs_clean"] == 0.0
         assert d["params_match_clean"] is True
+        # the post-hoc InvariantChecker replays the world's artifacts
+        assert d["invariants_ok"] is True, d["invariants_violations"]
+        assert "cohort_accounting" in d["invariants_checked"]
 
     @pytest.mark.slow  # ~2min bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's straggler smoke block
@@ -284,6 +301,7 @@ class TestPhaseChild:
         assert q["tracks_quorum_not_straggler"] is True
         assert q["wall_s"] < q["blocked_wall_bound_s"]
         assert q["peak_buffered"] == 0
+        assert q["invariants_ok"] is True, q["invariants_violations"]
         # async: exactly-once folds + staleness oracle across a restart
         a = d["async"]
         assert a["server_restarted"] is True
@@ -296,6 +314,8 @@ class TestPhaseChild:
         assert a["exactly_once"] is True
         assert a["stale_folds"] >= 1
         assert a["staleness_weights_match_oracle"] is True
+        assert a["invariants_ok"] is True, a["invariants_violations"]
+        assert "exactly_once_folds" in a["invariants_checked"]
 
     @pytest.mark.slow  # ~60s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's defense smoke block
@@ -331,6 +351,7 @@ class TestPhaseChild:
         # exactly-once accounting survives dup faults + quarantine
         assert d["exactly_once"] is True
         assert d["folds_total"] == d["uploads_aggregated"]
+        assert d["invariants_ok"] is True, d["invariants_violations"]
         # async: the construction-time rejection is gone — defenses
         # run per fold, the attacker is quarantined, folds hit target
         a = d["async"]
@@ -339,6 +360,49 @@ class TestPhaseChild:
         assert a["clipped_uploads"] > 0
         assert a["quarantine_rejected_uploads"] >= 1
         assert a["defended_within_bound"] is True
+        assert a["invariants_ok"] is True, a["invariants_violations"]
+
+    @pytest.mark.slow  # ~60s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's chaosplan smoke block
+    def test_chaosplan_smoke_child_writes_valid_json(self):
+        """The CI chaosplan smoke invocation (CPU): the deterministic
+        chaos plane runs end-to-end through bench.py's chaosplan phase
+        child and emits the detail.chaosplan contract keys — the
+        determinism pair reproducing an identical fault trace from the
+        same (schedule, seed), the crash-point sweep killing the server
+        at EVERY enumerated WAL-append / checkpoint-publish boundary
+        with recovery and clean invariants at each, and the combined
+        async+defense+registry world reaching its fold target under
+        scripted multi-layer faults with the InvariantChecker clean."""
+        d = self._run_child("chaosplan", 500, smoke=True)
+        det = d["determinism"]
+        assert det["all_steps_fired"] is True
+        assert det["counters_identical"] is True
+        assert det["trace_signature_identical"] is True
+        assert det["identical_fault_trace"] is True
+        s = d["sweep"]
+        assert s["write_boundaries"] >= 4
+        assert s["crash_points"] >= s["write_boundaries"]
+        assert s["recovered"] == s["crash_points"]
+        assert s["all_recovered"] is True
+        assert s["all_invariants_clean"] is True
+        # every enumerated boundary was actually swept, each mode there
+        modes = {(p["event"], p["mode"]) for p in s["points"]}
+        assert ("wal_append", "before") in modes
+        assert ("wal_append", "torn") in modes
+        assert ("wal_append", "after") in modes
+        assert ("ckpt_publish", "before") in modes
+        assert ("ckpt_publish", "after") in modes
+        c = d["combined"]
+        assert c["registry_clients"] == 100_000
+        assert len(c["cohort_client_ids"]) == c["clients"]
+        assert c["reached_fold_target"] is True
+        assert c["client_killed"] is True
+        assert c["chaos_faults"] >= len(c["cohort_client_ids"])
+        assert c["invariants_ok"] is True, c["invariants_violations"]
+        for inv in ("exactly_once_folds", "version_monotone",
+                    "no_reissued_seqs", "no_lost_unreported_folds"):
+            assert inv in c["invariants_checked"]
 
     @pytest.mark.slow  # ~100s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's planet smoke block

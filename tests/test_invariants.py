@@ -1,0 +1,434 @@
+"""Post-hoc invariant checker (core/invariants.py): replaying a run's
+round_wal.jsonl / telemetry.jsonl / trace.json artifacts must prove
+exactly-once folds, model-version monotonicity across restarts,
+quorum/cohort accounting, no reissued dispatch seqs and no
+lost-but-unreported folds — and catch every planted violation.
+"""
+
+import json
+import os
+
+import pytest
+
+from fedml_tpu.core.checkpoint import RoundWAL
+from fedml_tpu.core.invariants import InvariantChecker
+
+pytestmark = pytest.mark.smoke
+
+
+def _write_snapshot(d, counters, rank=0):
+    with open(os.path.join(d, "telemetry.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "ts": 0.0, "kind": "telemetry_snapshot", "rank": rank,
+            "role": "server", "counters": counters,
+        }) + "\n")
+
+
+def _write_trace(d, events):
+    with open(os.path.join(d, "trace.json"), "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def _check(d, **kw):
+    return InvariantChecker(telemetry_dir=str(d), **kw).check()
+
+
+def _violated(report, name):
+    return [v for v in report.violations if v["invariant"] == name]
+
+
+class TestSyncWalInvariants:
+    def test_clean_wal_passes(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        for r in range(3):
+            wal.append(r, r + 1, [1, 2, 3], folded=[1, 2, 3])
+        rep = _check(tmp_path)
+        assert rep.ok, rep.to_dict()
+        assert "round_monotone" in rep.checked
+
+    def test_fold_outside_cohort_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1, 2], folded=[1, 3])  # rank 3 never broadcast
+        rep = _check(tmp_path)
+        assert _violated(rep, "cohort_accounting")
+
+    def test_partial_close_needs_counter_evidence(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1, 2, 3], folded=[1, 2])  # rank 3 missing
+        _write_snapshot(tmp_path, {"cross_silo_rounds_total": 1.0})
+        rep = _check(tmp_path)
+        assert _violated(rep, "partial_closes_accounted")
+        # the same WAL with a quorum close in the counters is legal
+        for f in ("telemetry.jsonl",):
+            os.unlink(os.path.join(tmp_path, f))
+        _write_snapshot(tmp_path, {"agg_quorum_closes_total": 1.0})
+        rep = _check(tmp_path)
+        assert not _violated(rep, "partial_closes_accounted")
+
+    def test_backward_jump_must_land_on_durable_step(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1], folded=[1])
+        wal.append(1, 2, [1], folded=[1])
+        wal.append(3, None, [1], folded=[1])
+        wal.append(1, None, [1], folded=[1])  # resume onto ckpt_step 1? no: 1 ok
+        rep = _check(tmp_path)
+        # round 1 IS a durable step (record 0 carried ckpt_step 1)
+        assert not _violated(rep, "round_monotone")
+        wal2dir = tmp_path / "bad"
+        wal2dir.mkdir()
+        wal2 = RoundWAL(str(wal2dir))
+        wal2.append(0, None, [1], folded=[1])  # no checkpoint ever
+        wal2.append(1, None, [1], folded=[1])
+        wal2.append(0, None, [1], folded=[1])  # backward with nothing durable
+        rep = _check(wal2dir)
+        assert _violated(rep, "round_monotone")
+
+    def test_ckpt_step_regression_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 5, [1], folded=[1])
+        wal.append(1, 3, [1], folded=[1])  # checkpoint went backward
+        rep = _check(tmp_path)
+        assert _violated(rep, "ckpt_step_monotone")
+
+
+class TestAsyncWalInvariants:
+    def _publish(self, wal, version, pairs, max_seq, folds_total):
+        wal.append(
+            version, version, [], folded=pairs, kind="publish",
+            extra={"version": version, "max_seq": max_seq,
+                   "folds_total": folds_total},
+        )
+
+    def test_clean_async_ledger_passes(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1], [2, 2]], max_seq=4, folds_total=2)
+        self._publish(wal, 2, [[1, 5], [3, 3]], max_seq=6, folds_total=4)
+        rep = _check(tmp_path)
+        assert rep.ok, rep.to_dict()
+        assert "exactly_once_folds" in rep.checked
+
+    def test_refolded_pair_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1]], max_seq=2, folds_total=1)
+        self._publish(wal, 2, [[1, 1]], max_seq=3, folds_total=2)  # again!
+        # counters present and showing ZERO append failures: the repeat
+        # cannot be a carry (without telemetry the bound would skip —
+        # a whole-record repeat is indistinguishable from a legal
+        # carry-after-failed-append from the WAL alone)
+        _write_snapshot(tmp_path, {"agg_publish_total": 2.0})
+        rep = _check(tmp_path)
+        assert _violated(rep, "exactly_once_folds")
+
+    def test_version_regression_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 2, [[1, 1]], max_seq=2, folds_total=1)
+        self._publish(wal, 2, [[1, 2]], max_seq=3, folds_total=2)  # stuck
+        rep = _check(tmp_path)
+        assert _violated(rep, "version_monotone")
+
+    def test_seq_above_high_water_mark_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 9]], max_seq=4, folds_total=1)  # seq 9 > 4
+        rep = _check(tmp_path)
+        assert _violated(rep, "no_reissued_seqs")
+
+    def test_max_seq_regression_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1]], max_seq=8, folds_total=1)
+        self._publish(wal, 2, [[1, 2]], max_seq=4, folds_total=2)
+        rep = _check(tmp_path)
+        assert _violated(rep, "no_reissued_seqs")
+
+    def test_fold_total_under_ledger_flagged(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1], [2, 2]], max_seq=4, folds_total=1)
+        rep = _check(tmp_path)
+        assert _violated(rep, "fold_ledger_consistent")
+
+    def test_whole_record_carry_after_failed_append_is_legal(self, tmp_path):
+        # a failed-but-durable append (fsync refused after the bytes
+        # landed): the server re-carries the record's WHOLE pair set
+        # into the next successful record — legal exactly when the
+        # artifacts hold the matching wal_append_failures_total
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1], [2, 2]], max_seq=4, folds_total=2)
+        self._publish(
+            wal, 2, [[1, 1], [2, 2], [3, 3]], max_seq=6, folds_total=3
+        )
+        _write_snapshot(tmp_path, {"wal_append_failures_total": 1.0})
+        rep = _check(tmp_path)
+        assert not _violated(rep, "exactly_once_folds"), rep.to_dict()
+        # the SAME ledger whose counters show ZERO append failures is a
+        # double-fold
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        _write_snapshot(tmp_path, {"agg_publish_total": 2.0})
+        rep = _check(tmp_path)
+        assert _violated(rep, "exactly_once_folds")
+        # with NO telemetry at all the failure count is unknowable: the
+        # structural rules still apply but the bound skips, like every
+        # other counter-balanced invariant
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        rep = _check(tmp_path)
+        assert not _violated(rep, "exactly_once_folds")
+
+    def test_partial_repeat_is_never_a_carry(self, tmp_path):
+        # a carry re-writes the preceding failed record WHOLESALE;
+        # repeating only some of it is a refold no failure count can
+        # excuse
+        wal = RoundWAL(str(tmp_path))
+        self._publish(wal, 1, [[1, 1], [2, 2]], max_seq=4, folds_total=2)
+        self._publish(wal, 2, [[1, 1], [3, 3]], max_seq=6, folds_total=3)
+        _write_snapshot(tmp_path, {"wal_append_failures_total": 5.0})
+        rep = _check(tmp_path)
+        assert _violated(rep, "exactly_once_folds")
+
+
+class TestCounterCrossChecks:
+    def test_lost_unreported_folds_flagged_on_clean_finish(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(1, 1, [], folded=[[1, 1], [2, 2]], kind="publish",
+                   extra={"version": 1, "max_seq": 3, "folds_total": 2})
+        _write_snapshot(tmp_path, {
+            "agg_folds_total{mode=async}": 3.0,  # 3 accepted, 2 ledgered
+            "agg_folds_published_total": 2.0,
+            "cross_silo_finish_total": 1.0,
+        })
+        rep = _check(tmp_path)
+        assert _violated(rep, "no_lost_unreported_folds")
+        # the same gap REPORTED as lost is legal
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        _write_snapshot(tmp_path, {
+            "agg_folds_total{mode=async}": 3.0,
+            "agg_folds_published_total": 2.0,
+            "agg_folds_lost_total": 1.0,
+            "cross_silo_finish_total": 1.0,
+        })
+        rep = _check(tmp_path)
+        assert not _violated(rep, "no_lost_unreported_folds")
+
+    def test_append_failure_excuses_unledgered_folds(self, tmp_path):
+        # a failed FINAL append (disk-full on the flush) leaves
+        # accepted folds unledgered under the documented
+        # degraded-durability contract: with the failure counted, the
+        # loss accounting must skip, not flag
+        wal = RoundWAL(str(tmp_path))
+        wal.append(1, 1, [], folded=[[1, 1], [2, 2]], kind="publish",
+                   extra={"version": 1, "max_seq": 3, "folds_total": 2})
+        _write_snapshot(tmp_path, {
+            "agg_folds_total{mode=async}": 3.0,  # 3 accepted, 2 ledgered
+            "agg_folds_published_total": 2.0,
+            "wal_append_failures_total": 1.0,
+            "cross_silo_finish_total": 1.0,
+        })
+        rep = _check(tmp_path)
+        assert not _violated(rep, "no_lost_unreported_folds"), rep.to_dict()
+        assert "no_lost_unreported_folds" in rep.skipped
+
+    def test_unclean_finish_skips_loss_accounting(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(1, 1, [], folded=[[1, 1]], kind="publish",
+                   extra={"version": 1, "max_seq": 2, "folds_total": 1})
+        _write_snapshot(tmp_path, {"agg_folds_total{mode=async}": 5.0})
+        rep = _check(tmp_path)
+        assert "no_lost_unreported_folds" in rep.skipped
+
+    def test_ledger_counter_match_bounds_gap_by_crashes(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        for r in range(3):
+            wal.append(r, r + 1, [1, 2], folded=[1, 2])
+        # 3 durable records, only 1 counted, no crashes to explain it
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 1.0,
+            "wal_folds_logged_total": 2.0,
+            "agg_folds_total{mode=stream}": 6.0,
+        })
+        rep = _check(tmp_path)
+        assert _violated(rep, "ledger_counter_match")
+
+    def test_fold_gap_is_strict_with_no_faults(self, tmp_path):
+        # every record counted but one round's FOLDS were not: with
+        # zero injected crashes and zero append failures the bound
+        # collapses to exactly zero — the counter-drop regression this
+        # invariant exists to catch must not hide inside a one-record
+        # tolerance
+        wal = RoundWAL(str(tmp_path))
+        for r in range(2):
+            wal.append(r, r + 1, [1, 2], folded=[1, 2])
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 2.0,
+            "wal_folds_logged_total": 2.0,  # log holds 4
+            "agg_folds_total{mode=stream}": 4.0,
+        })
+        rep = _check(tmp_path)
+        assert _violated(rep, "ledger_counter_match")
+        # the same gap WITH a counted append failure is explained
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 2.0,
+            "wal_folds_logged_total": 2.0,
+            "wal_append_failures_total": 1.0,
+            "agg_folds_total{mode=stream}": 4.0,
+        })
+        rep = _check(tmp_path)
+        assert not _violated(rep, "ledger_counter_match"), rep.to_dict()
+
+    def test_only_kill_faults_explain_counter_gaps(self, tmp_path):
+        # the crash allowance counts kill/torn faults ONLY: a delay or
+        # clock skew cannot strand a counted record, so a gap "covered"
+        # by five injected latencies is still a violation
+        wal = RoundWAL(str(tmp_path))
+        for r in range(2):
+            wal.append(r, r + 1, [1, 2], folded=[1, 2])
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 1.0,
+            "wal_folds_logged_total": 2.0,
+            "chaos_faults_injected_total{event=wal_append,fault=latency}": 5.0,
+            "agg_folds_total{mode=stream}": 4.0,
+        })
+        rep = _check(tmp_path)
+        assert _violated(rep, "ledger_counter_match")
+        # the same gap with ONE injected kill is explained
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 1.0,
+            "wal_folds_logged_total": 2.0,
+            "chaos_faults_injected_total{event=wal_append,fault=kill_server}":
+                1.0,
+            "chaos_faults_injected_total{event=wal_append,fault=latency}": 5.0,
+            "agg_folds_total{mode=stream}": 4.0,
+        })
+        _write_trace(tmp_path, [
+            {"name": "chaos.fault", "ph": "i", "ts": t, "pid": 1, "tid": 1,
+             "args": {"fault": f, "event": "wal_append"}}
+            for t, f in enumerate(
+                ["kill_server"] + ["latency"] * 5
+            )
+        ])
+        rep = _check(tmp_path)
+        assert not _violated(rep, "ledger_counter_match"), rep.to_dict()
+
+    def test_publish_kill_tolerance_scales_with_record_size(self, tmp_path):
+        # a kill AFTER a multi-pair publish append strands the whole
+        # record's pairs before agg_folds_published_total increments:
+        # one injected kill must explain up to one record's worth
+        wal = RoundWAL(str(tmp_path))
+        wal.append(1, 1, [], folded=[[1, 1], [2, 2], [3, 3]],
+                   kind="publish",
+                   extra={"version": 1, "max_seq": 5, "folds_total": 3})
+        wal.append(2, 2, [], folded=[[1, 7], [2, 8], [3, 9]],
+                   kind="publish",
+                   extra={"version": 2, "max_seq": 12, "folds_total": 6})
+        key = "chaos_faults_injected_total{event=wal_append,fault=kill_server}"
+        # record 1 counted (3), record 2's three pairs stranded by the
+        # kill at its write boundary: gap 3 == one record's worth
+        _write_snapshot(tmp_path, {
+            "agg_folds_published_total": 3.0, key: 1.0,
+        })
+        _write_trace(tmp_path, [
+            {"name": "chaos.fault", "ph": "i", "ts": 1, "pid": 1, "tid": 1,
+             "args": {"fault": "kill_server", "event": "wal_append"}},
+        ])
+        rep = _check(tmp_path)
+        assert not _violated(rep, "published_counter_match"), rep.to_dict()
+        # the SAME gap with no kill to explain it is a violation
+        os.unlink(os.path.join(tmp_path, "telemetry.jsonl"))
+        _write_snapshot(tmp_path, {"agg_folds_published_total": 3.0})
+        rep = _check(tmp_path)
+        assert _violated(rep, "published_counter_match")
+
+    def test_reset_counters_skip_balances_not_fail(self, tmp_path):
+        # a multi-process restart resets the registry: counters are
+        # monotonic, so a decrease across a rank's successive snapshots
+        # proves it — the counter balances must SKIP (the WAL-internal
+        # invariants still apply), not report false violations
+        wal = RoundWAL(str(tmp_path))
+        for r in range(3):
+            wal.append(r, r + 1, [1, 2], folded=[1, 2])
+        _write_snapshot(tmp_path, {
+            "wal_rounds_logged_total": 2.0, "wal_folds_logged_total": 4.0,
+            "agg_folds_total{mode=stream}": 4.0,
+        })
+        _write_snapshot(tmp_path, {  # restarted incarnation: reset
+            "wal_rounds_logged_total": 1.0, "wal_folds_logged_total": 2.0,
+            "agg_folds_total{mode=stream}": 2.0,
+        })
+        rep = _check(tmp_path)
+        assert rep.ok, rep.to_dict()
+        assert "counters reset" in rep.skipped["ledger_counter_match"]
+        assert "counters reset" in rep.skipped["counters_cover_ledger"]
+
+    def test_counters_must_cover_ledger(self, tmp_path):
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1, 2], folded=[1, 2])
+        _write_snapshot(tmp_path, {
+            "agg_folds_total{mode=stream}": 1.0,  # ledger holds 2
+            "wal_rounds_logged_total": 1.0,
+            "wal_folds_logged_total": 2.0,
+        })
+        rep = _check(tmp_path)
+        assert _violated(rep, "counters_cover_ledger")
+
+
+class TestTraceCrossCheck:
+    def test_fault_counter_and_trace_must_agree(self, tmp_path):
+        _write_snapshot(tmp_path, {
+            "chaos_faults_injected_total{event=send,fault=drop}": 2.0,
+        })
+        _write_trace(tmp_path, [
+            {"name": "chaos.fault", "ph": "i", "ts": 1, "pid": 1, "tid": 1,
+             "args": {"fault": "drop", "event": "send"}},
+        ])
+        rep = _check(tmp_path)
+        assert _violated(rep, "chaos_trace_consistent")
+
+    def test_fault_signature_is_order_independent(self):
+        evs = [
+            {"name": "chaos.fault", "args": {"fault": "drop", "event": "send"}},
+            {"name": "chaos.fault", "args": {"fault": "latency",
+                                             "event": "wal_append"}},
+            {"name": "other", "args": {}},
+        ]
+        sig1 = InvariantChecker.fault_signature(evs)
+        sig2 = InvariantChecker.fault_signature(list(reversed(evs)))
+        assert sig1 == sig2 and len(sig1) == 2
+
+
+class TestSeparateCheckpointDir:
+    def test_wal_read_from_checkpoint_dir(self, tmp_path):
+        ck = tmp_path / "ck"
+        td = tmp_path / "td"
+        ck.mkdir()
+        td.mkdir()
+        wal = RoundWAL(str(ck))
+        wal.append(0, 1, [1], folded=[1])
+        rep = InvariantChecker(
+            telemetry_dir=str(td), checkpoint_dir=str(ck)
+        ).check()
+        assert "wal_well_formed" in rep.checked
+
+    def test_no_artifacts_all_skipped(self, tmp_path):
+        rep = _check(tmp_path)
+        assert rep.ok
+        assert "wal_well_formed" in rep.skipped
+
+
+class TestCliCheck:
+    def test_exit_codes_and_json_line(self, tmp_path, capsys):
+        from fedml_tpu.cli import main
+
+        wal = RoundWAL(str(tmp_path))
+        wal.append(0, 1, [1, 2], folded=[1, 2])
+        rc = main(["check", "--telemetry-dir", str(tmp_path)])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == 0 and out["ok"] is True
+        wal.append(1, 2, [1], folded=[1, 2])  # rank 2 outside cohort
+        rc = main(["check", "--telemetry-dir", str(tmp_path)])
+        captured = capsys.readouterr()
+        out = json.loads(captured.out.strip())
+        assert rc == 1 and out["ok"] is False
+        assert "cohort_accounting" in captured.err
+
+    def test_missing_dir_is_usage_error(self, tmp_path):
+        from fedml_tpu.cli import main
+
+        assert main(["check", "--telemetry-dir", str(tmp_path / "nope")]) == 2
