@@ -6,6 +6,16 @@
 # Re-measure with --durations=40 and re-tier when the gate drifts.
 set -e
 cd "$(dirname "$0")/.."
+
+# Static-analysis gate (fedml_tpu/analysis — docs/static_analysis.md):
+# pure-AST, no JAX import, runs in seconds. Ratcheted against the
+# checked-in lint_baseline.json: any NEW finding (hidden host sync /
+# retrace hazard / missed donation / unseeded randomness / swallowed
+# exception / unlocked cross-thread state / registry drift) fails, and
+# so does a STALE baseline entry — fixing a finding must shrink the
+# baseline in the same change.
+python -m fedml_tpu.cli lint --ci
+
 python -m pytest tests/ -m "smoke and not slow" -q "$@"
 
 # Round-pipeline smoke (K=2, 6 rounds, CPU): the async executor must run

@@ -19,14 +19,32 @@ import numpy as np
 
 from . import constants  # noqa: F401
 from .arguments import Arguments, load_arguments
-from .core.frame import (  # noqa: F401
-    ClientTrainer,
-    DefaultClientTrainer,
-    DefaultServerAggregator,
-    ServerAggregator,
-)
 
 __version__ = "0.1.0"
+
+# The L3 operator seam (core.frame) imports JAX transitively; loading
+# it lazily (PEP 562) keeps `import fedml_tpu` — and therefore the
+# pure-AST `fedml-tpu lint` CLI — free of any JAX import. Training
+# entry points touch these names (or core.frame directly) and pull
+# JAX in at that point, exactly as before.
+_LAZY_FRAME_EXPORTS = (
+    "ClientTrainer",
+    "DefaultClientTrainer",
+    "DefaultServerAggregator",
+    "ServerAggregator",
+)
+
+
+def __getattr__(name: str):
+    if name in _LAZY_FRAME_EXPORTS:
+        from .core import frame
+
+        return getattr(frame, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_FRAME_EXPORTS))
 
 _global_training_type: Optional[str] = None
 _global_comm_backend: Optional[str] = None

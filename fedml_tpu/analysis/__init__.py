@@ -1,0 +1,30 @@
+"""``fedml_tpu.analysis`` — the JAX-/federation-aware static-analysis
+suite behind ``fedml-tpu lint`` (docs/static_analysis.md).
+
+Pure stdlib: importing this package must never import JAX, NumPy or
+YAML — the CI gate runs the whole AST pass in seconds on a bare
+checkout. Rule ids (one checker each):
+
+- ``host-sync``    hidden device->host fetches on round/serving hot paths
+- ``retrace``      jit-in-loop, jit-over-mutable-self, traced-arg branching
+- ``donation``     donated buffers reused; round-shaped jits not donating
+- ``determinism``  global RNG / wall clock in seeded paths
+- ``except``       bare excepts and swallow-without-log/counter
+- ``thread-lock``  cross-thread attribute access without the owning lock
+- ``registry``     MSG_TYPE/telemetry/knob registries vs their docs+schema
+"""
+
+from .engine import (  # noqa: F401
+    BASELINE_NAME,
+    Finding,
+    ModuleSource,
+    RULES,
+    diff_baseline,
+    find_repo_root,
+    findings_to_counts,
+    load_baseline,
+    load_corpus,
+    main,
+    run_lint,
+    save_baseline,
+)

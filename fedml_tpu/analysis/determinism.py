@@ -1,0 +1,123 @@
+"""Rule ``determinism`` — unseeded randomness / wall clocks in paths
+that promise seeded reproducibility.
+
+The round path (sampling, aggregation, defenses), the chaos plane
+("an identical (schedule, seed) pair reproduces the identical fault
+trace") and the data/poison synthesis all document bit-level or
+draw-level determinism. A single ``np.random.rand()`` or
+``random.random()`` against the *global* RNG breaks that silently —
+and ``np.random.seed()`` / ``random.seed()`` is worse: it clobbers
+every other component's stream (the exact bug PR 2 fixed in client
+sampling). ``time.time()`` in these modules is flagged too: wall
+clocks leak into decisions that replays cannot reproduce (telemetry
+/ tracing modules are deliberately off this list — timestamps are
+their job).
+
+Allowed and never flagged: ``np.random.RandomState(seed)`` /
+``np.random.default_rng(seed)`` / ``random.Random(seed)`` instances,
+``np.random.SeedSequence``/``Generator`` type references, and any
+derived-key JAX randomness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import Finding, ModuleSource
+
+RULE = "determinism"
+
+# modules (files or directory prefixes ending in /) that document
+# seeded reproducibility
+SEEDED_PATHS = (
+    "fedml_tpu/core/aggregation.py",
+    "fedml_tpu/core/defense.py",
+    "fedml_tpu/core/round_pipeline.py",
+    "fedml_tpu/core/chaos.py",
+    "fedml_tpu/core/secure_agg.py",
+    "fedml_tpu/core/partition.py",
+    "fedml_tpu/core/scheduler.py",
+    "fedml_tpu/scale/",
+    "fedml_tpu/data/",
+    "fedml_tpu/simulation/",
+    "fedml_tpu/cross_silo/",
+    "fedml_tpu/cross_device/",
+)
+
+# np.random.<attr> that are constructors/types for locally-seeded
+# streams, not draws from the global RNG
+_SEEDED_FACTORIES = {
+    "RandomState", "default_rng", "Generator", "SeedSequence",
+    "PCG64", "Philox",
+}
+
+
+def _in_seeded_path(path: str) -> bool:
+    return any(
+        path == p or (p.endswith("/") and path.startswith(p))
+        for p in SEEDED_PATHS
+    )
+
+
+def check_determinism(mod: ModuleSource) -> List[Finding]:
+    if not _in_seeded_path(mod.path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        # time.time()
+        if (
+            node.attr == "time"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("time", "_time")
+        ):
+            findings.append(Finding(
+                path=mod.path, line=node.lineno, rule=RULE,
+                message=(
+                    "time.time() in a seeded/deterministic path — wall "
+                    "clocks are unreplayable; use a monotonic clock for "
+                    "durations or thread a timestamp in"
+                ),
+            ))
+            continue
+        # np.random.<draw> on the GLOBAL stream
+        v = node.value
+        if (
+            isinstance(v, ast.Attribute)
+            and v.attr == "random"
+            and isinstance(v.value, ast.Name)
+            and v.value.id in ("np", "numpy", "onp")
+        ):
+            if node.attr in _SEEDED_FACTORIES:
+                continue
+            what = (
+                "np.random.seed() reseeds the GLOBAL NumPy RNG and "
+                "clobbers every other component's stream"
+                if node.attr == "seed"
+                else f"np.random.{node.attr} draws from the global NumPy "
+                     "RNG in a seeded path"
+            )
+            findings.append(Finding(
+                path=mod.path, line=node.lineno, rule=RULE,
+                message=f"{what}; derive a local RandomState/key instead",
+            ))
+            continue
+        # random.<draw> on the stdlib global stream
+        if (
+            isinstance(v, ast.Name)
+            and v.id == "random"
+            and node.attr not in ("Random", "SystemRandom")
+        ):
+            what = (
+                "random.seed() reseeds the GLOBAL stdlib RNG"
+                if node.attr == "seed"
+                else f"random.{node.attr} draws from the global stdlib "
+                     "RNG in a seeded path"
+            )
+            findings.append(Finding(
+                path=mod.path, line=node.lineno, rule=RULE,
+                message=f"{what}; derive a local random.Random(seed) instead",
+            ))
+    return findings

@@ -1,0 +1,372 @@
+"""Rules ``retrace`` and ``donation`` — jit lifecycle hazards.
+
+``retrace`` (FedJAX's core lesson, PAPERS.md 2108.02117: JAX-FL
+performance lives or dies on a trace-stable round loop):
+
+- ``jax.jit(...)`` constructed inside a ``for``/``while`` loop — a
+  fresh jit wrapper per iteration compiles every time and caches
+  nothing;
+- a jitted **lambda / nested function closing over ``self``** — the
+  closure captures mutable attributes by reference, so attribute
+  churn silently bakes stale values into the trace (or retraces);
+- Python ``if``/``while`` **branching on a traced parameter** inside
+  a ``@jax.jit`` function with no ``static_argnums``/``static_argnames``
+  — value-dependent control flow either fails to trace or retraces
+  per value.
+
+``donation`` (SNIPPETS [1]; ROADMAP item 5's donation audit):
+
+- an argument donated via ``donate_argnums`` whose buffer is **read
+  again after the call** — donation invalidates it; XLA may have
+  aliased the output into it;
+- a **round-shaped jit** (name mentions train/round/step/update/fold/
+  epoch) in a hot-path module built **without** ``donate_argnums`` —
+  every call copies the params instead of updating in place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import Finding, ModuleSource
+
+RULE_RETRACE = "retrace"
+RULE_DONATION = "donation"
+
+_ROUND_SHAPED = ("train", "round", "step", "update", "fold", "epoch")
+
+# donation is a per-call perf contract; only the round/serving hot
+# paths are held to it (same set as the host-sync rule, plus the
+# trainer seams that own the per-round executables)
+DONATION_HOT_MODULES = {
+    "fedml_tpu/core/round_pipeline.py",
+    "fedml_tpu/core/aggregation.py",
+    "fedml_tpu/core/frame.py",
+    "fedml_tpu/core/local_trainer.py",
+    "fedml_tpu/scale/engine.py",
+    "fedml_tpu/distributed.py",
+    "fedml_tpu/simulation/fedavg_api.py",
+    "fedml_tpu/simulation/decentralized.py",
+    "fedml_tpu/cross_silo/horizontal/fedml_aggregator.py",
+}
+
+
+def _is_jit_func(fn: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` (any dotted tail ending in .jit)."""
+    if isinstance(fn, ast.Name):
+        return fn.id == "jit"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "jit"
+    return False
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node if ``node`` constructs a jitted function:
+    ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_func(node.func):
+        return node
+    if (
+        isinstance(node.func, (ast.Name, ast.Attribute))
+        and (
+            (isinstance(node.func, ast.Name) and node.func.id == "partial")
+            or (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "partial")
+        )
+        and node.args
+        and _is_jit_func(node.args[0])
+    ):
+        return node
+    return None
+
+
+def _jit_keywords(call: ast.Call) -> Set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _references_self(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Name) and sub.id == "self"
+        for sub in ast.walk(node)
+    )
+
+
+def _decorated_jit(fn: ast.AST) -> Optional[ast.Call]:
+    """For a FunctionDef decorated with jit, the decorator Call (or a
+    synthesized empty one for a bare ``@jax.jit``)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if _is_jit_func(dec):
+            return ast.Call(func=dec, args=[], keywords=[])
+        call = _jit_call(dec)
+        if call is not None:
+            return call
+    return None
+
+
+def check_retrace(mod: ModuleSource) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # (a) jit constructed inside a loop
+    class LoopVisitor(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.loop_depth = 0
+
+        def visit_For(self, node):  # noqa: N802
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_While = visit_For  # noqa: N815
+
+        def visit_Call(self, node):  # noqa: N802
+            call = _jit_call(node)
+            if call is not None and self.loop_depth > 0:
+                findings.append(Finding(
+                    path=mod.path, line=node.lineno, rule=RULE_RETRACE,
+                    message=(
+                        "jax.jit constructed inside a loop — a fresh "
+                        "wrapper per iteration compiles every time; "
+                        "hoist the jit out of the loop"
+                    ),
+                ))
+            self.generic_visit(node)
+
+    LoopVisitor().visit(mod.tree)
+
+    # collect nested function defs per scope so a jit of a local
+    # function that closes over self can be resolved by name
+    local_funcs: Dict[Tuple[int, str], ast.FunctionDef] = {}
+    for scope in ast.walk(mod.tree):
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in ast.walk(scope):
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt is not scope
+                ):
+                    local_funcs[(id(scope), stmt.name)] = stmt
+
+    # (b) jitted lambda / local function closing over self
+    for scope in ast.walk(mod.tree):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(scope):
+            call = _jit_call(node) if isinstance(node, ast.Call) else None
+            if call is None:
+                continue
+            # the jitted object: first arg of jax.jit(...), second of
+            # partial(jax.jit, fn)
+            target = None
+            if _is_jit_func(call.func):
+                target = call.args[0] if call.args else None
+            elif call.args and _is_jit_func(call.args[0]):
+                target = call.args[1] if len(call.args) > 1 else None
+            if target is None:
+                continue
+            closes_over_self = False
+            if isinstance(target, ast.Lambda) and _references_self(target.body):
+                closes_over_self = True
+            elif isinstance(target, ast.Name):
+                local = local_funcs.get((id(scope), target.id))
+                if local is not None and _references_self(local):
+                    closes_over_self = True
+            if closes_over_self:
+                findings.append(Finding(
+                    path=mod.path, line=node.lineno, rule=RULE_RETRACE,
+                    message=(
+                        "jitted function closes over `self` — mutable "
+                        "attributes are baked into the trace (stale "
+                        "values) or force retraces; pass them as "
+                        "arguments instead"
+                    ),
+                ))
+
+    # (c) value-dependent Python branching on a traced parameter
+    for fn in ast.walk(mod.tree):
+        dec = _decorated_jit(fn)
+        if dec is None:
+            continue
+        if _jit_keywords(dec) & {"static_argnums", "static_argnames"}:
+            continue  # some params are static; branching may be fine
+        params = {
+            a.arg for a in fn.args.args + fn.args.kwonlyargs
+            if a.arg not in ("self", "cls")
+        }
+        if not params:
+            continue
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            test_names = {
+                sub.id for sub in ast.walk(stmt.test)
+                if isinstance(sub, ast.Name)
+            }
+            traced = sorted(test_names & params)
+            if traced:
+                findings.append(Finding(
+                    path=mod.path, line=stmt.lineno, rule=RULE_RETRACE,
+                    message=(
+                        f"Python branch on traced argument "
+                        f"'{traced[0]}' inside a @jax.jit function "
+                        "with no static_argnums — use lax.cond/select "
+                        "or mark the arg static"
+                    ),
+                ))
+    return findings
+
+
+def _donated_indices(call: ast.Call) -> List[int]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    out.append(elt.value)
+            return out
+    return []
+
+
+def _target_names(node: ast.AST) -> Set[str]:
+    """Unparsed names/attribute chains bound by an assignment target."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            out.add(ast.unparse(sub))
+    return out
+
+
+def check_donation(mod: ModuleSource) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # map of jitted-callable name -> donated positional indices,
+    # gathered from `<name> = jax.jit(..., donate_argnums=...)` and
+    # `self.<name> = jax.jit(...)` assignments anywhere in the module
+    donating: Dict[str, List[int]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        call = _jit_call(node.value)
+        if call is None:
+            continue
+        tgt = node.targets[0]
+        name = None
+        if isinstance(tgt, ast.Name):
+            name = tgt.id
+        elif isinstance(tgt, ast.Attribute):
+            name = tgt.attr
+        if name is None:
+            continue
+        donated = _donated_indices(call)
+        if donated:
+            donating[name] = donated
+        if (
+            mod.path in DONATION_HOT_MODULES
+            and any(tok in name.lower() for tok in _ROUND_SHAPED)
+            and not donated
+        ):
+            findings.append(Finding(
+                path=mod.path, line=node.lineno, rule=RULE_DONATION,
+                message=(
+                    f"round-shaped jit '{name}' has no donate_argnums "
+                    "— each call copies its inputs instead of updating "
+                    "in place (SNIPPETS [1]); donate the carried state "
+                    "or mark the line `# lint: donation-ok`"
+                ),
+            ))
+
+    if not donating:
+        return findings
+
+    # use-after-donation, per function scope, flow-approximate:
+    # a donated positional arg that is a plain name/attribute read
+    # again on a LATER line of the same function (and not rebound by
+    # the call's own assignment) is a read of an invalidated buffer
+    for scope in ast.walk(mod.tree):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        donated_exprs: List[Tuple[str, int]] = []  # (expr text, call line)
+        for stmt in ast.walk(scope):
+            calls = []
+            if isinstance(stmt, ast.Assign):
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, ast.Call):
+                        calls.append((sub, stmt))
+            elif isinstance(stmt, ast.Expr):
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, ast.Call):
+                        calls.append((sub, stmt))
+            for call, owner in calls:
+                fn = call.func
+                callee = (
+                    fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                idxs = donating.get(callee)
+                if not idxs:
+                    continue
+                rebinds: Set[str] = set()
+                if isinstance(owner, ast.Assign):
+                    for t in owner.targets:
+                        rebinds |= _target_names(t)
+                for i in idxs:
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if not isinstance(arg, (ast.Name, ast.Attribute)):
+                        continue
+                    expr = ast.unparse(arg)
+                    if expr in rebinds:
+                        continue  # x = f(x): the donated name is rebound
+                    # anchor past the WHOLE call statement — a
+                    # multi-line call's own arguments are not
+                    # "reads after the call"
+                    stmt_end = max(
+                        getattr(call, "end_lineno", call.lineno) or call.lineno,
+                        getattr(owner, "end_lineno", call.lineno)
+                        or call.lineno,
+                    )
+                    donated_exprs.append((expr, stmt_end))
+        if not donated_exprs:
+            continue
+        # store lines per expression — a rebind between the donating
+        # call and a read makes the read a read of the NEW value
+        store_lines: Dict[str, List[int]] = {}
+        for sub in ast.walk(scope):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(sub, "ctx", None), ast.Store
+            ):
+                store_lines.setdefault(ast.unparse(sub), []).append(sub.lineno)
+        for expr, call_line in donated_exprs:
+            for sub in ast.walk(scope):
+                if (
+                    isinstance(sub, (ast.Name, ast.Attribute))
+                    and isinstance(getattr(sub, "ctx", None), ast.Load)
+                    and sub.lineno > call_line
+                ):
+                    if ast.unparse(sub) != expr:
+                        continue
+                    if any(
+                        call_line < s <= sub.lineno
+                        for s in store_lines.get(expr, ())
+                    ):
+                        continue  # rebound before this read
+                    findings.append(Finding(
+                        path=mod.path, line=sub.lineno, rule=RULE_DONATION,
+                        message=(
+                            f"'{expr}' is read after being donated to a "
+                            "jit call — donation invalidates the "
+                            "buffer; reorder the read or drop the "
+                            "donation"
+                        ),
+                    ))
+                    break  # one finding per donated expr is enough
+    return findings

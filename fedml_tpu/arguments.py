@@ -343,6 +343,19 @@ _DEFAULTS: Dict[str, Any] = {
     # local copy exists (reference data/MNIST/data_loader.py:17-29
     # behavior; off by default so offline runs never stall on egress)
     "download": False,
+    # crash recovery / serving feed (core/checkpoint.py): directory for
+    # orbax round checkpoints + the round WAL. None disables both —
+    # a crashed server then restarts the federation from round 0
+    "checkpoint_dir": None,
+    # save a checkpoint every N completed rounds. None keeps each
+    # scenario's historical cadence (simulation: every 10 rounds;
+    # cross-silo/distributed: every round; async ALWAYS checkpoints
+    # every publish regardless — see fedml_server_manager)
+    "checkpoint_freq": None,
+    # elastic membership: highest client rank an unknown ONLINE may
+    # register as — one misconfigured hello must not bloat the server
+    # with ghost ranks
+    "max_clients": 4096,
 }
 
 _SECTIONS = (
@@ -654,6 +667,32 @@ class Arguments:
                 f"stall_timeout_s={self.stall_timeout_s}: must be >= 0 "
                 "(0 disables the stall watchdog)"
             )
+        raw = getattr(self, "max_clients")
+        try:
+            # a YAML `max_clients: null` must name the knob (the
+            # defense-knob convention), never coerce silently
+            self.max_clients = int(raw)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"max_clients={raw!r}: must be an integer"
+            ) from None
+        if self.max_clients < 1:
+            raise ValueError(
+                f"max_clients={self.max_clients}: must be >= 1"
+            )
+        raw = getattr(self, "checkpoint_freq")
+        if raw is not None:  # None = the scenario's historical cadence
+            try:
+                self.checkpoint_freq = int(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"checkpoint_freq={raw!r}: must be an integer (or "
+                    "null for the scenario default)"
+                ) from None
+            if self.checkpoint_freq < 1:
+                raise ValueError(
+                    f"checkpoint_freq={self.checkpoint_freq}: must be >= 1"
+                )
         for int_key in ("trace_ring_size", "metrics_port"):
             setattr(self, int_key, int(getattr(self, int_key)))
         if self.trace_ring_size < 1:

@@ -133,7 +133,7 @@ class Broker:
         try:
             self._server.close()
         except OSError:
-            pass
+            logging.debug("broker: server close failed", exc_info=True)
 
 
 class BrokerClient:
@@ -181,7 +181,7 @@ class BrokerClient:
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
-            pass
+            logging.debug("broker client: shutdown failed", exc_info=True)
         self._sock.close()
 
 
@@ -239,8 +239,8 @@ def ensure_broker(
             probe = socket.create_connection((host, port), timeout=0.5)
             probe.close()
             return (host, port)
-        except OSError:
-            pass
+        except OSError:  # lint: except-ok — probe loop: refusal IS the
+            pass  # signal "not up yet"; the deadline below reports failure
         if local:
             if use_native:
                 from .native_broker import spawn_native_broker

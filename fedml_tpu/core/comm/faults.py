@@ -169,8 +169,8 @@ class FaultInjector(BaseCommunicationManager):
             finally:
                 try:
                     self._timers.remove(t_ref[0])
-                except ValueError:
-                    pass
+                except ValueError:  # lint: except-ok — benign race: stop()
+                    pass  # drained the list while this timer was firing
 
         t = threading.Timer(delay_s, fire)
         t_ref.append(t)

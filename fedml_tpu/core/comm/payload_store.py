@@ -17,6 +17,7 @@ algorithms never know which plane carried their tensors.
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 import uuid
@@ -28,6 +29,7 @@ from flax import serialization
 
 from ... import constants
 from ..message import Message
+from ..telemetry import Telemetry
 from .base import BaseCommunicationManager, Observer
 
 _URL_SUFFIX = "_url"
@@ -83,7 +85,12 @@ class FilePayloadStore(PayloadStore):
         try:
             os.remove(url[len("file://") :])
         except OSError:
-            pass
+            # a leaked payload file is disk pressure, not correctness —
+            # but it must be visible, not silent
+            logging.debug("payload store: delete(%s) failed", url, exc_info=True)
+            Telemetry.get_instance().inc(
+                "comm_internal_errors_total", site="payload_delete"
+            )
 
     def exists(self, url: str) -> bool:
         return os.path.exists(url[len("file://") :])
@@ -108,7 +115,13 @@ class FilePayloadStore(PayloadStore):
                 except OSError:
                     continue
         except OSError:
-            pass
+            logging.debug(
+                "payload store: gc sweep of %s failed", self.root,
+                exc_info=True,
+            )
+            Telemetry.get_instance().inc(
+                "comm_internal_errors_total", site="payload_gc"
+            )
 
 
 def params_to_bytes(params: Any) -> bytes:

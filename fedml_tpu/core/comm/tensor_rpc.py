@@ -250,7 +250,10 @@ class TensorRpcCommunicationManager(BaseCommunicationManager):
         try:
             pipe.close()
         except OSError:
-            pass
+            logging.debug(
+                "tensor rpc: evicted pipe to %d close failed", receiver,
+                exc_info=True,
+            )
 
     def send_message(self, msg: Message) -> None:
         receiver = int(msg.get_receiver_id())
@@ -292,9 +295,9 @@ class TensorRpcCommunicationManager(BaseCommunicationManager):
                 try:
                     s.close()
                 except OSError:
-                    pass
+                    logging.debug("tensor rpc: pipe close failed", exc_info=True)
             self._pipes.clear()
         try:
             self._server.close()
         except OSError:
-            pass
+            logging.debug("tensor rpc: server close failed", exc_info=True)

@@ -60,7 +60,7 @@ def build_native(source: str, output: str, extra_flags: Sequence[str] = ()) -> O
         try:
             os.remove(tmp)
         except OSError:
-            pass
+            logging.debug("native: temp %s cleanup failed", tmp, exc_info=True)
         return None
 
 

@@ -130,8 +130,8 @@ class RoundPipeline:
         if api._multi_controller:
             # one fetch for the whole chain — process-consistent host
             # values, outside the hot loop
-            keys_arr = np.asarray(keys_arr)
-            heads_arr = np.asarray(heads_arr)
+            keys_arr = np.asarray(keys_arr)  # lint: host-sync-ok
+            heads_arr = np.asarray(heads_arr)  # lint: host-sync-ok — one pre-loop fetch (comment above)
         keys = [keys_arr[i] for i in range(n)]
         heads = [heads_arr[i] for i in range(n)]
         return idx_plan, lr_plan, keys, heads
@@ -152,7 +152,7 @@ class RoundPipeline:
         bucket = bucket_cohort(
             n_per_round,
             self.bucket_policy,
-            max_size=int(api.dataset.client_num),
+            max_size=int(api.dataset.client_num),  # lint: host-sync-ok — host metadata
             shard_multiple=shard_multiple,
         )
         idx_plan, lr_plan, key_plan, head_plan = self._precompute(
@@ -242,7 +242,7 @@ class RoundPipeline:
             # round just dispatched, i.e. fully synchronous)
             inflight.append(summed["count"])
             while len(inflight) >= self.depth:
-                jax.block_until_ready(inflight.popleft())
+                jax.block_until_ready(inflight.popleft())  # lint: host-sync-ok — THE back-pressure sync (depth bound)
             if tel is not None:
                 tel.inc("pipeline_rounds_dispatched_total")
                 tel.heartbeat("pipeline.round", round_idx)
@@ -316,7 +316,9 @@ class RoundPipeline:
             "test_loss": te["loss"],
             "round": round_idx,
             "round_time_s": duration_s if duration_s is not None else 0.0,
-            "train_loss_cohort": float(summed["loss_sum"])
-            / max(float(summed["count"]), 1.0),
+            # eval-round flush: metrics leave the device here by
+            # design (DeferredMetrics already drained)
+            "train_loss_cohort": float(summed["loss_sum"])  # lint: host-sync-ok
+            / max(float(summed["count"]), 1.0),  # lint: host-sync-ok
         }
         return stats

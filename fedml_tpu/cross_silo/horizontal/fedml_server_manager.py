@@ -271,7 +271,10 @@ class FedMLServerManager(ServerManager):
 
             self._ckpt = RoundCheckpointer(ckpt_dir)
             self._wal = RoundWAL(ckpt_dir)
-            self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
+            # None = this scenario's historical cadence (every round)
+            self._ckpt_freq = max(
+                1, int(getattr(args, "checkpoint_freq", None) or 1)
+            )
             state = self._ckpt.restore()
             if state is not None:
                 import jax
@@ -292,6 +295,18 @@ class FedMLServerManager(ServerManager):
                     "cross-silo server resumed at round %d from %s",
                     self.round_idx, ckpt_dir,
                 )
+                # PR 10's pinned pre-existing race: init used to wait
+                # for ALL ranks to re-announce, but a client killed
+                # BEFORE the server crash never will — its heartbeats
+                # died with it. Arm the failure detector over every
+                # expected rank NOW: survivors' beats/ONLINEs refresh
+                # the watch; a rank silent past heartbeat_timeout_s is
+                # declared dead pre-init and leaves the awaited set
+                # (_ready_to_init). Without a detector the resumed
+                # server keeps the reference behavior (wait for all).
+                if self._failure_detector is not None:
+                    for r in range(1, len(self.client_real_ids) + 1):
+                        self._failure_detector.watch(r)
                 if self.agg_mode == "async":
                     # version/seq/fold counters ride the checkpoint;
                     # the WAL's publish records are the exactly-once
@@ -409,18 +424,7 @@ class FedMLServerManager(ServerManager):
                 # have landed is idempotent by design)
                 self._maybe_resync(sender)
                 return
-            if self.elastic:
-                ready = len(self._active_ranks()) >= int(
-                    self.args.client_num_per_round
-                )
-            else:
-                ready = all(
-                    self.client_online_status.get(rank, False)
-                    for rank in range(1, len(self.client_real_ids) + 1)
-                )
-            if ready:
-                self.is_initialized = True
-                self.send_init_msg()
+            self._maybe_init()
         elif status == constants.CLIENT_STATUS_OFFLINE:
             if not self.elastic:
                 logging.warning("OFFLINE from rank %d ignored (non-elastic)", sender)
@@ -447,6 +451,29 @@ class FedMLServerManager(ServerManager):
                 else:
                     # the leaver also shrank the quorum denominator
                     self._maybe_arm_quorum()
+
+    def _ready_to_init(self) -> bool:
+        """The presence handshake's readiness predicate. Non-elastic:
+        every expected rank must be online — EXCEPT ranks the failure
+        detector has declared dead (a client killed before a server
+        crash never re-announces; a resumed server must not await a
+        corpse — the PR 10 pinned race). An all-dead world is
+        vacuously ready: init falls through to the loud
+        no-online-clients finish instead of blocking forever."""
+        if self.elastic:
+            return len(self._active_ranks()) >= int(
+                self.args.client_num_per_round
+            )
+        return all(
+            self.client_online_status.get(rank, False)
+            for rank in range(1, len(self.client_real_ids) + 1)
+            if rank not in self._dead_ranks
+        )
+
+    def _maybe_init(self) -> None:
+        if not self.is_initialized and self._ready_to_init():
+            self.is_initialized = True
+            self.send_init_msg()
 
     # -- liveness / failure detection (beyond the reference) ----------
     def handle_message_heartbeat(self, msg: Message) -> None:
@@ -511,8 +538,6 @@ class FedMLServerManager(ServerManager):
 
     def handle_message_client_dead(self, msg: Message) -> None:
         rank = int(msg.get(constants.MSG_ARG_KEY_RANK, -1))
-        if not self.client_online_status.get(rank, False):
-            return  # already offline/dead; stale declaration
         if (
             self._failure_detector is not None
             and self._failure_detector.seen_recently(rank)
@@ -520,6 +545,23 @@ class FedMLServerManager(ServerManager):
             # raced: a message from this rank was queued behind the
             # death notice — it is alive after all
             self._failure_detector.watch(rank)
+            return
+        if not self.client_online_status.get(rank, False):
+            if self.is_initialized or rank in self._dead_ranks:
+                return  # already offline/dead; stale declaration
+            # pre-init death on a RESUMED server (__init__ armed the
+            # detector over every expected rank): this rank was killed
+            # before the crash and will never re-announce — stop
+            # awaiting it, and re-check whether the survivors complete
+            # the handshake (the PR 10 pinned async-restart race)
+            self._dead_ranks.add(rank)
+            self.deaths += 1
+            self.telemetry.inc("cross_silo_clients_declared_dead_total")
+            logging.warning(
+                "rank %d declared DEAD before init (no reconnect since "
+                "the server restart); init proceeds without it", rank,
+            )
+            self._maybe_init()
             return
         self.client_online_status[rank] = False
         self._dead_ranks.add(rank)
@@ -545,6 +587,10 @@ class FedMLServerManager(ServerManager):
                 # dead rank leaves the denominator, so a quorum that
                 # was one corpse short arms its grace timer now
                 self._maybe_arm_quorum()
+        elif not self.is_initialized:
+            # an announced-then-killed rank must not stall the
+            # handshake either: the survivors may now complete it
+            self._maybe_init()
 
     def _async_client_gone(self, rank: int) -> None:
         """A dead/left rank in async mode: retire its in-flight

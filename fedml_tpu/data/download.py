@@ -142,7 +142,10 @@ def _fetch_once(url: str, dest: str) -> None:
             try:
                 os.unlink(tmp_name)
             except OSError:
-                pass
+                logging.debug(
+                    "download: temp %s cleanup failed", tmp_name,
+                    exc_info=True,
+                )
 
 
 def _extract(archive: str, out_dir: str) -> None:

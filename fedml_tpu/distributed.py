@@ -139,7 +139,10 @@ class DistributedTrainer:
 
             multihost = is_multi_controller(self.mesh)
             self._ckpt = RoundCheckpointer(ckpt_dir, multihost=multihost)
-            self._ckpt_freq = max(1, int(getattr(args, "checkpoint_freq", 1)))
+            # None = this scenario's historical cadence (every epoch)
+            self._ckpt_freq = max(
+                1, int(getattr(args, "checkpoint_freq", None) or 1)
+            )
 
             def norm_sharding(c):
                 # mesh-placed leaves keep their layout; leaves optax

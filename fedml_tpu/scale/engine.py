@@ -216,7 +216,7 @@ class PlanetRoundLoop:
                 # semantics). The flag burns only on OBSERVED
                 # truncation: an all-light round 0 must not silence a
                 # long-tail round 1.
-                total = int(self.registry.num_samples[idx].sum())
+                total = int(self.registry.num_samples[idx].sum())  # lint: host-sync-ok — registry columns are host NumPy
                 packed = int(
                     sum(g.num_samples.sum() for g in plan.groups)
                 )

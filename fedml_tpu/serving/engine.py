@@ -130,8 +130,8 @@ class ServingEngine:
             # put here would deadlock stop() at exactly the overload
             # moment an operator is most likely shutting down
             self.admission.queue.put_nowait(STOP)
-        except queue.Full:
-            pass
+        except queue.Full:  # lint: except-ok — full queue means the
+            pass  # worker is already exiting via _stop_evt (see above)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

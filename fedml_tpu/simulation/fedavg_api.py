@@ -491,7 +491,10 @@ class FedAvgAPI:
 
         from ..core.checkpoint import RoundCheckpointer
 
-        self._ckpt_freq = max(1, int(getattr(self.args, "checkpoint_freq", 10)))
+        # None = this scenario's historical cadence (every 10 rounds)
+        self._ckpt_freq = max(
+            1, int(getattr(self.args, "checkpoint_freq", None) or 10)
+        )
         ckpt = RoundCheckpointer(ckpt_dir)
         restored = ckpt.restore()
         start_round = 0

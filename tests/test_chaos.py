@@ -691,3 +691,124 @@ class TestScheduledCrashWorld:
         report = InvariantChecker(telemetry_dir=td, checkpoint_dir=ck).check()
         assert report.ok, report.to_dict()
         assert "chaos_trace_consistent" in report.checked
+
+
+@pytest.mark.slow  # two LOCAL worlds + a restart (>4s fast-gate budget)
+class TestAsyncRestartRace:
+    """PR 10's pinned pre-existing race, reproduced deterministically
+    with a chaos schedule and fixed: a client killed BEFORE the server
+    crash never re-announces, and the restarted server's init used to
+    await ALL ranks — hanging forever. The resumed server now arms the
+    failure detector over every expected rank at construction; a rank
+    silent past heartbeat_timeout_s is declared dead pre-init and
+    leaves the awaited set, so the handshake completes over the
+    survivors."""
+
+    def _build(self, args_factory, run_id, rank, **kw):
+        import fedml_tpu
+        from fedml_tpu import models
+        from fedml_tpu.data import load
+        from test_cross_silo import _mk_args
+
+        a = _mk_args(args_factory, run_id, "LOCAL", **kw)
+        a.rank = rank
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def test_client_killed_before_server_crash_does_not_stall_resume(
+        self, args_factory, tmp_path
+    ):
+        import fedml_tpu
+        from fedml_tpu.core.invariants import InvariantChecker
+        from fedml_tpu.cross_silo import Client, Server
+
+        reset_chaos()
+        Telemetry.reset()
+        ck = str(tmp_path / "ck")
+        td = str(tmp_path / "td")
+        kw = dict(
+            comm_round=3,
+            checkpoint_dir=ck,
+            checkpoint_freq=1,
+            telemetry_dir=td,
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=1.0,
+            client_num_in_total=2,
+            client_num_per_round=2,
+            chaos_schedule=[
+                # rank 1 dies mid-train of its FIRST round: its
+                # heartbeats die with it, long before the server does
+                {"at": {"event": "barrier", "name": "client.train",
+                        "rank": 1, "occurrence": 1},
+                 "fault": "kill_client"},
+                # ... then the server is killed at the next round's
+                # WAL-append boundary
+                {"at": {"event": "wal_append", "occurrence": 2},
+                 "fault": {"kind": "kill_server", "when": "before"}},
+            ],
+        )
+        run_id = "async_restart_race"
+        a0, ds0, m0 = self._build(args_factory, run_id, 0, **kw)
+        server = Server(a0, None, ds0, m0)
+        clients = []
+        for r in (1, 2):
+            a, ds, m = self._build(args_factory, run_id, r, **kw)
+            clients.append(Client(a, None, ds, m))
+        killed = {}
+
+        def srv():
+            try:
+                server.run()
+            except ProcessKilled as e:
+                killed["where"] = e.where
+                if server.manager._failure_detector is not None:
+                    server.manager._failure_detector.stop()
+
+        def cli(c):
+            try:
+                c.run()
+            except ProcessKilled:
+                pass  # the scheduled rank-1 kill
+
+        threads = [
+            threading.Thread(target=cli, args=(c,), daemon=True)
+            for c in clients
+        ]
+        for t in threads:
+            t.start()
+        st = threading.Thread(target=srv, daemon=True)
+        st.start()
+        st.join(timeout=120)
+        assert not st.is_alive(), "first incarnation never crashed"
+        assert killed, "scheduled server kill never fired"
+
+        # restart: rank 1 is long dead and will never re-announce.
+        # Pre-fix, this run() blocked forever awaiting rank 1's ONLINE.
+        a0b, _, m0b = self._build(args_factory, run_id, 0, **kw)
+        server2 = Server(a0b, None, ds0, m0b)
+        done = {}
+
+        def srv2():
+            server2.run()
+            done["ok"] = True
+
+        st2 = threading.Thread(target=srv2, daemon=True)
+        st2.start()
+        st2.join(timeout=90)
+        assert done.get("ok"), (
+            "resumed server never initialized: a dead rank still "
+            "stalls the restart handshake"
+        )
+        # the world actually recovered: all rounds ran, the dead rank
+        # was declared (not silently forgotten), and the surviving
+        # client was released cleanly
+        assert server2.manager.round_idx == 3
+        assert 1 in server2.manager._dead_ranks
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        # acceptance: the invariant checker is green on the artifacts
+        report = InvariantChecker(telemetry_dir=td, checkpoint_dir=ck).check()
+        assert report.ok, report.to_dict()

@@ -1,0 +1,664 @@
+"""`fedml-tpu lint` — the static-analysis suite (docs/static_analysis.md).
+
+Three layers:
+
+- **fixture corpus**: one known-bad + known-good snippet per rule,
+  asserting the exact (file, line, rule-id) each checker reports;
+- **ratchet**: baseline semantics — a NEW finding fails, a STALE
+  suppression fails, counts ratchet per (path, rule, message) key;
+- **HEAD gate**: the repo itself lints clean against the checked-in
+  ``lint_baseline.json`` (in-process for the fast tier; the CLI
+  subprocess end-to-end run carries the slow mark).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fedml_tpu.analysis import determinism, exceptions, hostsync, jit, threads
+from fedml_tpu.analysis.engine import (
+    BASELINE_NAME,
+    Finding,
+    ModuleSource,
+    diff_baseline,
+    find_repo_root,
+    findings_to_counts,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from fedml_tpu.analysis.registry import check_registry
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mod(path: str, src: str) -> ModuleSource:
+    return ModuleSource.parse(path, textwrap.dedent(src))
+
+
+def _hits(findings, rule):
+    return [(f.line, f.rule) for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------
+# rule fixtures
+# ---------------------------------------------------------------------
+
+class TestHostSyncChecker:
+    HOT = "fedml_tpu/core/aggregation.py"
+
+    def test_flags_conversions_item_and_materializers(self):
+        mod = _mod(self.HOT, """\
+            import numpy as np
+            def fold(x):
+                a = float(x)
+                b = x.item()
+                c = np.asarray(x)
+                return a, b, c
+            """)
+        fs = hostsync.check_host_sync(mod)
+        assert _hits(fs, "host-sync") == [(3, "host-sync"), (4, "host-sync"), (5, "host-sync")]
+
+    def test_device_reductions_are_not_safe_sources(self):
+        """`sum(host_list)` is host-side, but `x.sum()` / `jnp.sum(x)`
+        reduce ON DEVICE — the exact per-round fetch shape the rule
+        exists for must not slip through the builtin allowlist."""
+        mod = _mod(self.HOT, """\
+            import jax.numpy as jnp
+            def fold(x, losses):
+                a = float(x.sum())
+                b = float(jnp.sum(x))
+                c = float(losses.get("k"))
+                d = int(sum([1, 2]))
+                return a, b, c, d
+            """)
+        assert [f.line for f in hostsync.check_host_sync(mod)] == [3, 4, 5]
+
+    def test_knob_coercion_metadata_and_constants_are_clean(self):
+        mod = _mod(self.HOT, """\
+            def setup(x, args):
+                lr = float(getattr(args, "learning_rate", 0.1))
+                n = int(x.shape[0])
+                k = int(len(x))
+                z = float(3)
+                return lr, n, k, z
+            """)
+        assert hostsync.check_host_sync(mod) == []
+
+    def test_init_is_construction_time(self):
+        mod = _mod(self.HOT, """\
+            class Engine:
+                def __init__(self, q):
+                    self.depth = int(q)
+                def step(self, q):
+                    return int(q)
+            """)
+        assert [f.line for f in hostsync.check_host_sync(mod)] == [5]
+
+    def test_cold_modules_are_out_of_scope(self):
+        mod = _mod("fedml_tpu/data/loader.py", "x = float(open('f').read())\n")
+        assert hostsync.check_host_sync(mod) == []
+
+    def test_inline_suppression_covers_only_its_line(self):
+        mod = _mod(self.HOT, """\
+            def fold(x):
+                a = float(x)  # lint: host-sync-ok
+                b = float(x)
+                return a, b
+            """)
+        fs = [
+            f for f in hostsync.check_host_sync(mod)
+            if not mod.is_suppressed(f.rule, f.line)
+        ]
+        assert [f.line for f in fs] == [3]
+
+    def test_standalone_suppression_covers_next_line(self):
+        mod = _mod(self.HOT, """\
+            def fold(x):
+                # lint: host-sync-ok — deliberate flush
+                a = float(x)
+                return a
+            """)
+        fs = [
+            f for f in hostsync.check_host_sync(mod)
+            if not mod.is_suppressed(f.rule, f.line)
+        ]
+        assert fs == []
+
+
+class TestRetraceChecker:
+    def test_jit_inside_loop(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import jax
+            def f(xs):
+                out = []
+                for x in xs:
+                    g = jax.jit(lambda y: y + 1)
+                    out.append(g(x))
+                return out
+            """)
+        assert _hits(jit.check_retrace(mod), "retrace") == [(5, "retrace")]
+
+    def test_jitted_lambda_closing_over_self(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import jax
+            class C:
+                def build(self):
+                    self._fn = jax.jit(lambda p: p * self.scale)
+            """)
+        assert _hits(jit.check_retrace(mod), "retrace") == [(4, "retrace")]
+
+    def test_jitted_local_function_closing_over_self(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import jax
+            class C:
+                def build(self):
+                    def fwd(p, x):
+                        return self.model.apply(p, x)
+                    self._fwd = jax.jit(fwd)
+            """)
+        assert _hits(jit.check_retrace(mod), "retrace") == [(6, "retrace")]
+
+    def test_branch_on_traced_arg(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import jax
+            @jax.jit
+            def h(x, n):
+                if n > 3:
+                    return x
+                return -x
+            """)
+        assert _hits(jit.check_retrace(mod), "retrace") == [(4, "retrace")]
+
+    def test_static_argnums_branching_is_fine(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import functools, jax
+            @functools.partial(jax.jit, static_argnums=1)
+            def h(x, n):
+                if n > 3:
+                    return x
+                return -x
+            """)
+        assert jit.check_retrace(mod) == []
+
+    def test_module_level_jit_is_fine(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import jax
+            @jax.jit
+            def f(x):
+                return x + 1
+            g = jax.jit(f)
+            """)
+        assert jit.check_retrace(mod) == []
+
+
+class TestDonationChecker:
+    HOT = "fedml_tpu/core/round_pipeline.py"
+
+    def test_donated_arg_read_after_call(self):
+        mod = _mod(self.HOT, """\
+            import jax
+            step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            def loop(params, batch):
+                new = step(params, batch)
+                stale = params
+                return new, stale
+            """)
+        assert _hits(jit.check_donation(mod), "donation") == [(5, "donation")]
+
+    def test_rebound_donation_is_clean(self):
+        mod = _mod(self.HOT, """\
+            import jax
+            step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            def loop(params, batch):
+                params = step(params, batch)
+                return params
+            """)
+        assert jit.check_donation(mod) == []
+
+    def test_multiline_call_args_are_not_reads_after(self):
+        mod = _mod(self.HOT, """\
+            import jax
+            step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            def loop(params, batch):
+                out = step(
+                    params,
+                    batch,
+                )
+                params = out
+                return params
+            """)
+        assert jit.check_donation(mod) == []
+
+    def test_round_shaped_jit_without_donation(self):
+        mod = _mod(self.HOT, """\
+            import jax
+            def build(fn):
+                round_fn = jax.jit(fn)
+                return round_fn
+            """)
+        fs = jit.check_donation(mod)
+        assert _hits(fs, "donation") == [(3, "donation")]
+        assert "donate_argnums" in fs[0].message
+
+    def test_round_shaped_jit_outside_hot_modules_is_fine(self):
+        mod = _mod("fedml_tpu/models/cnn.py", """\
+            import jax
+            def build(fn):
+                round_fn = jax.jit(fn)
+                return round_fn
+            """)
+        assert jit.check_donation(mod) == []
+
+
+class TestDeterminismChecker:
+    SEEDED = "fedml_tpu/scale/registry.py"
+
+    def test_global_rng_and_wall_clock(self):
+        mod = _mod(self.SEEDED, """\
+            import time, random
+            import numpy as np
+            def sample(n):
+                t = time.time()
+                np.random.seed(0)
+                r = np.random.rand(n)
+                j = random.random()
+                return t, r, j
+            """)
+        assert _hits(determinism.check_determinism(mod), "determinism") == [
+            (4, "determinism"), (5, "determinism"), (6, "determinism"),
+            (7, "determinism"),
+        ]
+
+    def test_seeded_factories_and_monotonic_are_clean(self):
+        mod = _mod(self.SEEDED, """\
+            import time, random
+            import numpy as np
+            def sample(n, seed):
+                rs = np.random.RandomState(seed)
+                g = np.random.default_rng(seed)
+                r = random.Random(seed)
+                t = time.monotonic()
+                return rs.rand(n), g, r, t
+            """)
+        assert determinism.check_determinism(mod) == []
+
+    def test_unlisted_modules_are_out_of_scope(self):
+        mod = _mod("fedml_tpu/core/telemetry.py", "import time\nt = time.time()\n")
+        assert determinism.check_determinism(mod) == []
+
+
+class TestExceptionChecker:
+    def test_bare_except_and_silent_pass(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+            """)
+        fs = exceptions.check_exceptions(mod)
+        assert [(f.line, f.rule) for f in fs] == [(4, "except"), (4, "except")]
+
+    def test_logged_and_counted_handlers_are_clean(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import logging
+            def f(tel):
+                try:
+                    g()
+                except OSError:
+                    logging.debug("g failed", exc_info=True)
+                try:
+                    g()
+                except ValueError:
+                    tel.inc("x_internal_errors_total")
+                try:
+                    g()
+                except KeyError:
+                    raise RuntimeError("ctx")
+            """)
+        assert exceptions.check_exceptions(mod) == []
+
+    def test_control_flow_handlers_are_not_swallows(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import queue
+            def f(q):
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                return item
+            """)
+        assert exceptions.check_exceptions(mod) == []
+
+
+class TestThreadLockChecker:
+    def test_unlocked_cross_thread_attr(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._loop)
+                def _loop(self):
+                    while True:
+                        self.count += 1
+                def snapshot(self):
+                    return self.count
+            """)
+        fs = threads.check_thread_shared_state(mod)
+        assert _hits(fs, "thread-lock") == [(9, "thread-lock"), (11, "thread-lock")]
+
+    def test_fully_guarded_class_is_clean(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._loop)
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.count += 1
+                def snapshot(self):
+                    with self._lock:
+                        return self.count
+            """)
+        assert threads.check_thread_shared_state(mod) == []
+
+    def test_thread_private_state_is_clean(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._thread = threading.Thread(target=self._loop)
+                def _loop(self):
+                    self.scratch = 0
+                    self.scratch += 1
+            """)
+        assert threads.check_thread_shared_state(mod) == []
+
+    def test_timer_closure_target_is_scanned(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import threading
+            class Worker:
+                def arm(self):
+                    def fire():
+                        self.fired = True
+                    t = threading.Timer(1.0, fire)
+                    t.start()
+                def check(self):
+                    return self.fired
+            """)
+        fs = threads.check_thread_shared_state(mod)
+        assert [f.line for f in fs] == [5, 9]
+
+    def test_thread_safe_named_attrs_are_exempt(self):
+        mod = _mod("fedml_tpu/core/x.py", """\
+            import threading
+            class Worker:
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop)
+                def _loop(self):
+                    self._thread = None
+                def stop(self):
+                    return self._thread
+            """)
+        assert threads.check_thread_shared_state(mod) == []
+
+
+class TestRegistryChecker:
+    CONSTANTS = "fedml_tpu/constants.py"
+    ARGUMENTS = "fedml_tpu/arguments.py"
+
+    def _constants(self, src):
+        return _mod(self.CONSTANTS, src)
+
+    def _arguments(self, defaults="_DEFAULTS = {'comm_round': 10}\n"):
+        return _mod(self.ARGUMENTS, defaults)
+
+    def test_orphaned_msg_type(self):
+        corpus = [
+            self._constants("MSG_TYPE_A = 1\nMSG_TYPE_ORPHAN = 2\n"),
+            self._arguments(),
+            _mod("fedml_tpu/core/m.py", """\
+                from .. import constants
+                class M:
+                    def register(self):
+                        self.register_message_receive_handler(
+                            constants.MSG_TYPE_A, self.h)
+                """),
+        ]
+        fs = check_registry(corpus, docs_text="")
+        orphans = [f for f in fs if "MSG_TYPE_ORPHAN" in f.message]
+        assert len(orphans) == 1
+        assert (orphans[0].path, orphans[0].line) == (self.CONSTANTS, 2)
+        assert not any("MSG_TYPE_A " in f.message for f in fs)
+
+    def test_comm_layer_comparison_counts_as_dispatch(self):
+        corpus = [
+            self._constants("MSG_TYPE_ACK = 50\n"),
+            self._arguments(),
+            _mod("fedml_tpu/core/comm/r.py", """\
+                from ... import constants
+                def on_msg(t):
+                    return t == constants.MSG_TYPE_ACK
+                """),
+        ]
+        fs = check_registry(corpus, docs_text="")
+        assert not any("MSG_TYPE_ACK" in f.message for f in fs)
+
+    def test_counter_naming_and_documentation(self):
+        corpus = [
+            self._constants(""),
+            self._arguments(),
+            _mod("fedml_tpu/core/t.py", """\
+                def f(tel):
+                    tel.inc("good_things_total")
+                    tel.inc("bad_things")
+                    tel.set_gauge("depth_now_total")
+                    tel.observe("latency")
+                """),
+        ]
+        fs = check_registry(corpus, docs_text="`good_things_total` docs")
+        msgs = sorted(f.message for f in fs)
+        assert any("'bad_things' does not end in _total" in m for m in msgs)
+        assert any("'depth_now_total' ends in _total" in m for m in msgs)
+        assert any("'latency' has no unit suffix" in m for m in msgs)
+        # documented counter passes the docs check; the others fail it
+        assert not any(
+            "good_things_total' is not documented" in m for m in msgs
+        )
+        assert any("'bad_things' is not documented" in m for m in msgs)
+
+    def test_undeclared_knob_read(self):
+        corpus = [
+            self._constants(""),
+            self._arguments("_DEFAULTS = {'comm_round': 10}\n"),
+            _mod("fedml_tpu/core/k.py", """\
+                def f(args):
+                    a = args.comm_round
+                    b = getattr(args, "mystery_knob", 3)
+                    args.derived_at_runtime = 1
+                    c = args.derived_at_runtime
+                    d = args.rank
+                    return a, b, c, d
+                """),
+        ]
+        fs = check_registry(corpus, docs_text="")
+        knob = [f for f in fs if "mystery_knob" in f.message]
+        assert len(knob) == 1
+        assert (knob[0].path, knob[0].line) == ("fedml_tpu/core/k.py", 3)
+        # declared, runtime-assigned, and identity attrs are covered
+        assert not any("comm_round" in f.message for f in fs)
+        assert not any("derived_at_runtime" in f.message for f in fs)
+        assert not any("args.rank" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------
+
+class TestBaselineRatchet:
+    def _f(self, path="fedml_tpu/core/x.py", line=3, rule="except", msg="m"):
+        return Finding(path=path, line=line, rule=rule, message=msg)
+
+    def test_new_finding_fails(self):
+        base = findings_to_counts([self._f()])
+        new, stale = diff_baseline([self._f(), self._f(line=9, msg="other")], base)
+        assert [f.message for f in new] == ["other"]
+        assert stale == []
+
+    def test_stale_suppression_fails(self):
+        base = findings_to_counts([self._f(), self._f(msg="gone")])
+        new, stale = diff_baseline([self._f()], base)
+        assert new == []
+        assert stale == ["fedml_tpu/core/x.py:except:gone"]
+
+    def test_line_drift_does_not_churn(self):
+        base = findings_to_counts([self._f(line=3)])
+        new, stale = diff_baseline([self._f(line=300)], base)
+        assert (new, stale) == ([], [])
+
+    def test_count_ratchet_per_key(self):
+        base = findings_to_counts([self._f(), self._f(line=5)])
+        # same key, three occurrences now: one is new
+        new, stale = diff_baseline(
+            [self._f(), self._f(line=5), self._f(line=7)], base
+        )
+        assert len(new) == 1 and stale == []
+        # one fixed: the surplus baseline count is stale
+        new, stale = diff_baseline([self._f()], base)
+        assert new == [] and len(stale) == 1
+
+    def test_save_and_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        save_baseline(p, [self._f(), self._f(line=5)])
+        loaded = load_baseline(p)
+        assert loaded == {"fedml_tpu/core/x.py:except:m": 2}
+
+
+# ---------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------
+
+class TestRepoAtHead:
+    def test_repo_lints_clean_against_checked_in_baseline(self):
+        root = find_repo_root(REPO)
+        findings = run_lint(root)
+        baseline = load_baseline(os.path.join(root, BASELINE_NAME))
+        new, stale = diff_baseline(findings, baseline)
+        assert new == [], "\n".join(f.render() for f in new)
+        assert stale == [], "\n".join(stale)
+
+    def test_every_rule_has_fixture_coverage_and_catalog_entry(self):
+        """The rule set, the docs catalog and this test file must move
+        together."""
+        from fedml_tpu.analysis import RULES
+
+        with open(os.path.join(REPO, "docs", "static_analysis.md")) as fh:
+            catalog = fh.read()
+        for rule in RULES:
+            assert f"`{rule}`" in catalog, f"{rule} missing from the catalog"
+
+    @pytest.mark.slow  # subprocess pays interpreter+numpy startup
+    def test_subset_run_is_clean_and_skips_registry_baseline(self):
+        """A per-file run must not read the project-wide registry
+        checker's baseline entries as stale (it never runs on
+        subsets), and must judge only the named file's entries."""
+        out = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.cli", "lint",
+             "fedml_tpu/distributed.py", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout.splitlines()[-1])
+        assert payload["ok"] is True and payload["stale"] == []
+
+    @pytest.mark.slow
+    def test_update_baseline_rejects_subset_runs(self):
+        """`--update-baseline` on a path subset would overwrite the
+        whole ledger with one file's findings — refused."""
+        out = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.cli", "lint",
+             "fedml_tpu/distributed.py", "--update-baseline"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 2
+        assert "FULL run" in out.stderr
+
+    def test_undocumented_counter_in_core_module_is_a_new_finding(self):
+        """Acceptance: injecting an undocumented counter into a core
+        module fails the gate (in-process — the corpus is patched, the
+        tree never touched)."""
+        from fedml_tpu.analysis.engine import load_corpus
+
+        root = find_repo_root(REPO)
+        corpus = load_corpus(root)
+        for i, m in enumerate(corpus):
+            if m.path == "fedml_tpu/core/losses.py":
+                corpus[i] = ModuleSource.parse(
+                    m.path,
+                    m.text + "\n\ndef _probe(tel):\n"
+                             "    tel.inc(\"totally_new_probe_total\")\n",
+                )
+        findings = run_lint(root, corpus=corpus)
+        baseline = load_baseline(os.path.join(root, BASELINE_NAME))
+        new, _stale = diff_baseline(findings, baseline)
+        assert any(
+            f.rule == "registry" and "totally_new_probe_total" in f.message
+            for f in new
+        )
+
+    @pytest.mark.slow  # subprocess pays interpreter+numpy startup
+    def test_cli_lint_ci_exits_zero_at_head_without_jax(self):
+        env = dict(os.environ)
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; from fedml_tpu.cli import main; "
+             "rc = main(['lint', '--ci', '--json']); "
+             "assert 'jax' not in sys.modules, 'lint imported jax'; "
+             "sys.exit(rc)"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout.splitlines()[-1])
+        assert payload["ok"] is True
+        assert payload["new"] == [] and payload["stale"] == []
+
+    @pytest.mark.slow
+    def test_cli_json_reports_injected_violation(self, tmp_path):
+        """End-to-end CI-gate semantics: a bare except planted in a
+        core module makes `lint --ci --json` fail with the finding."""
+        victim = os.path.join(REPO, "fedml_tpu", "core", "losses.py")
+        with open(victim) as fh:
+            original = fh.read()
+        try:
+            with open(victim, "a") as fh:
+                fh.write("\n\ndef _probe():\n    try:\n        return 1\n"
+                         "    except:\n        pass\n")
+            out = subprocess.run(
+                [sys.executable, "-m", "fedml_tpu.cli", "lint", "--ci",
+                 "--json"],
+                cwd=REPO, capture_output=True, text=True, timeout=300,
+            )
+        finally:
+            with open(victim, "w") as fh:
+                fh.write(original)
+        assert out.returncode == 1
+        payload = json.loads(out.stdout.splitlines()[-1])
+        assert any(
+            f["rule"] == "except" and f["path"].endswith("losses.py")
+            for f in payload["new"]
+        )
