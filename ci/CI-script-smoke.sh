@@ -16,6 +16,18 @@ cd "$(dirname "$0")/.."
 # baseline in the same change.
 python -m fedml_tpu.cli lint --ci
 
+# Compiled-artifact audit gate (fedml_tpu/analysis/compiled.py +
+# audit.py — docs/static_analysis.md): AOT-lowers every registered
+# hot-path executable (round fn, aggregation term/fold jits, planet
+# group jit, serving forward) across the pow2 shape census — NOTHING
+# executes, no data exists — and verifies donation aliasing,
+# host-transfer freedom, census size and baked-constant budgets
+# against the checked-in audit_baseline.json (new findings AND stale
+# entries both fail; --update-baseline is rejected here). Also emits
+# audit_report.json: per-executable static FLOPs/bytes, the MFU
+# roofline denominator for the BENCH captures.
+JAX_PLATFORMS=cpu python -m fedml_tpu.cli audit --ci
+
 python -m pytest tests/ -m "smoke and not slow" -q "$@"
 
 # Round-pipeline smoke (K=2, 6 rounds, CPU): the async executor must run

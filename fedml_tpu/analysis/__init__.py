@@ -1,17 +1,29 @@
 """``fedml_tpu.analysis`` — the JAX-/federation-aware static-analysis
-suite behind ``fedml-tpu lint`` (docs/static_analysis.md).
+suite behind ``fedml-tpu lint`` and ``fedml-tpu audit``
+(docs/static_analysis.md).
 
 Pure stdlib: importing this package must never import JAX, NumPy or
 YAML — the CI gate runs the whole AST pass in seconds on a bare
-checkout. Rule ids (one checker each):
+checkout (the audit engine imports JAX lazily, only when a lowering
+actually runs). Source rule ids (one checker each):
 
 - ``host-sync``    hidden device->host fetches on round/serving hot paths
 - ``retrace``      jit-in-loop, jit-over-mutable-self, traced-arg branching
 - ``donation``     donated buffers reused; round-shaped jits not donating
-- ``determinism``  global RNG / wall clock in seeded paths
+- ``determinism``  global RNG / wall clock in seeded paths (+ tests/,
+                   relaxed profile)
 - ``except``       bare excepts and swallow-without-log/counter
 - ``thread-lock``  cross-thread attribute access without the owning lock
 - ``registry``     MSG_TYPE/telemetry/knob registries vs their docs+schema
+
+Compiled-artifact rule ids (``audit.py``, over AOT-lowered HLO —
+nothing executes):
+
+- ``aot-donation``      claimed donations must alias in the artifact;
+                        round-shaped executables must alias at all
+- ``aot-host-transfer`` no infeed/outfeed/callbacks in hot executables
+- ``aot-census``        lowered shape keys within the pow2 budget
+- ``aot-constant``      no large non-splat baked-in constants
 """
 
 from .engine import (  # noqa: F401

@@ -1,0 +1,359 @@
+"""``fedml-tpu audit`` — compiled-artifact verification over the
+:mod:`fedml_tpu.analysis.compiled` registry (docs/static_analysis.md).
+
+Four checkers over each registered executable's AOT-lowered StableHLO
+(lowering traces; **nothing executes**, no data exists, a CPU-only box
+finishes the whole census in bounded time):
+
+- ``aot-donation``     — input–output aliasing must cover every buffer
+  the docstrings claim donated; a round-shaped executable with ZERO
+  aliasing is a finding (the compiled ground truth behind the lint
+  suite's source-level donation TODOs).
+- ``aot-host-transfer``— no infeed/outfeed/host custom-calls/python
+  callbacks in hot executables: the compiled-HLO counterpart of the
+  lint suite's source-level host-sync rule.
+- ``aot-census``       — lowered shape keys per executable must fit
+  the pow2 bucket budget (a census overflow is a retrace storm
+  compiled into the artifact set).
+- ``aot-constant``     — no large non-splat baked-in constants
+  (closure-captured arrays force per-value recompiles and waste HBM).
+
+Static cost (XLA cost analysis: FLOPs / bytes accessed per
+executable) is emitted into ``audit_report.json`` — the denominator
+the TPU MFU trajectory (ROADMAP item 5) is measured against.
+
+Findings ride the SAME count-keyed baseline/ratchet machinery as the
+lint suite (``engine.diff_baseline``), against a checked-in
+``audit_baseline.json``: CI (``fedml-tpu audit --ci``) fails on any
+NEW finding and on any STALE entry.
+
+Import discipline: importing this module must not import JAX — the
+CLI builds its parser from here on a bare checkout. JAX loads inside
+:func:`run_audit`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .compiled import (
+    AuditContext,
+    AuditableSpec,
+    LoweringCase,
+    load_registry,
+    lower_case,
+)
+from .engine import (
+    Finding,
+    find_repo_root,
+    run_ratchet_cli,
+)
+
+AUDIT_BASELINE_NAME = "audit_baseline.json"
+AUDIT_REPORT_NAME = "audit_report.json"
+
+RULE_DONATION = "aot-donation"
+RULE_HOST = "aot-host-transfer"
+RULE_CENSUS = "aot-census"
+RULE_CONSTANT = "aot-constant"
+
+AUDIT_RULES = (RULE_DONATION, RULE_HOST, RULE_CENSUS, RULE_CONSTANT)
+
+_BASELINE_COMMENT = (
+    "Ratchet-only suppression ledger for `fedml-tpu audit` "
+    "(docs/static_analysis.md — compiled-artifact audit). Entries are "
+    "compile-time contract violations accepted as known TODOs (e.g. a "
+    "round-shaped executable that cannot donate yet); they may only "
+    "be REMOVED (by fixing the executable). CI fails on new findings "
+    "AND on stale entries. Regenerate with `fedml-tpu audit "
+    "--update-baseline` after a burn-down."
+)
+
+
+def audit_spec(
+    spec: AuditableSpec, ctx: AuditContext
+) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """Lower one spec's census and run the four checkers. Returns
+    (findings, per-case report entries)."""
+    findings: List[Finding] = []
+    entries: List[Dict[str, Any]] = []
+    try:
+        cases = spec.provider(ctx)
+    except Exception as e:
+        raise RuntimeError(
+            f"auditable '{spec.name}' ({spec.path}): provider failed to "
+            f"build its census: {e}"
+        ) from e
+    budget = spec.census_budget
+    if callable(budget):
+        budget = budget(ctx)
+    if budget is not None and len(cases) > int(budget):
+        findings.append(Finding(
+            path=spec.path, line=0, rule=RULE_CENSUS,
+            message=(
+                f"executable '{spec.name}': {len(cases)} lowered shape "
+                f"keys exceed the pow2 census budget of {int(budget)} — "
+                "a census overflow is a retrace storm compiled into "
+                "the artifact set"
+            ),
+        ))
+    for case in cases:
+        try:
+            art = lower_case(spec, case)
+        except Exception as e:
+            raise RuntimeError(
+                f"auditable '{spec.name}' case '{case.key}' "
+                f"({spec.path}): AOT lowering failed: {e}"
+            ) from e
+        if spec.donate and art.aliased_inputs < art.claimed_donated_leaves:
+            findings.append(Finding(
+                path=spec.path, line=0, rule=RULE_DONATION,
+                message=(
+                    f"executable '{spec.name}': docstring claims "
+                    f"donate_argnums={tuple(spec.donate)} but the "
+                    f"lowered module aliases only {art.aliased_inputs} "
+                    f"of {art.claimed_donated_leaves} donated input "
+                    "buffers — an unmatched donation copies instead of "
+                    "updating in place"
+                ),
+            ))
+        elif (
+            spec.round_shaped
+            and not spec.donate
+            and art.aliased_inputs == 0
+        ):
+            findings.append(Finding(
+                path=spec.path, line=0, rule=RULE_DONATION,
+                message=(
+                    f"executable '{spec.name}' is round-shaped but its "
+                    "compiled artifact has zero input-output aliasing "
+                    "— the carried state is copied every call; donate "
+                    "it (SNIPPETS [1], ROADMAP item 5) or baseline "
+                    "this as a known TODO"
+                ),
+            ))
+        if spec.hot and art.host_transfers:
+            findings.append(Finding(
+                path=spec.path, line=0, rule=RULE_HOST,
+                message=(
+                    f"executable '{spec.name}': hot executable lowers "
+                    "host-transfer ops "
+                    f"({', '.join(art.host_transfers)}) — every call "
+                    "stalls the device on the host"
+                ),
+            ))
+        if art.max_constant_bytes > spec.constant_budget_bytes:
+            findings.append(Finding(
+                path=spec.path, line=0, rule=RULE_CONSTANT,
+                message=(
+                    f"executable '{spec.name}': baked-in constant of "
+                    f"{art.max_constant_bytes} bytes exceeds the "
+                    f"{spec.constant_budget_bytes}-byte budget — "
+                    "closure-captured arrays force per-value recompiles "
+                    "and waste HBM; pass them as arguments"
+                ),
+            ))
+        entry: Dict[str, Any] = {
+            "executable": spec.name,
+            "case": case.key,
+            "path": spec.path,
+            "round_shaped": spec.round_shaped,
+            "hot": spec.hot,
+            "claimed_donated_leaves": art.claimed_donated_leaves,
+            "aliased_inputs": art.aliased_inputs,
+            "host_transfers": art.host_transfers,
+            "max_constant_bytes": art.max_constant_bytes,
+            "flops": art.flops,
+            "bytes_accessed": art.bytes_accessed,
+        }
+        if art.flops and art.bytes_accessed:
+            # arithmetic intensity (FLOPs/byte): where this executable
+            # sits on the roofline — the compile-time denominator the
+            # BENCH MFU captures divide measured wall time into
+            entry["arithmetic_intensity"] = art.flops / art.bytes_accessed
+        entries.append(entry)
+    return findings, entries
+
+
+def run_audit(
+    ctx: Optional[AuditContext] = None,
+    only: Optional[Sequence[str]] = None,
+    registry: Optional[Dict[str, AuditableSpec]] = None,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Lower and check every registered executable. ``registry`` is
+    injectable for tests; ``only`` filters by executable name. The
+    registry always comes from the imported package — there is no
+    root-relative corpus here (unlike lint), so no root parameter."""
+    import jax
+
+    ctx = ctx or AuditContext()
+    specs = registry if registry is not None else load_registry()
+    names = sorted(specs)
+    if only:
+        missing = sorted(set(only) - set(names))
+        if missing:
+            raise KeyError(
+                f"unknown auditable(s) {missing}; registered: {names}"
+            )
+        names = [n for n in names if n in set(only)]
+    findings: List[Finding] = []
+    executables: List[Dict[str, Any]] = []
+    for name in names:
+        f, entries = audit_spec(specs[name], ctx)
+        findings.extend(f)
+        executables.extend(entries)
+    report = {
+        "version": 1,
+        "tool": "fedml-tpu audit",
+        "platform": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "census": ctx.to_dict(),
+        "executables": executables,
+        # the MFU-denominator view (ROADMAP item 5): per round-shaped
+        # executable and census case, the static FLOPs a BENCH capture
+        # divides its measured wall time into
+        "roofline": [
+            {
+                "executable": e["executable"],
+                "case": e["case"],
+                "flops": e["flops"],
+                "bytes_accessed": e["bytes_accessed"],
+                "arithmetic_intensity": e.get("arithmetic_intensity"),
+            }
+            for e in executables
+            if e["round_shaped"] and e["flops"] is not None
+        ],
+    }
+    return sorted(findings), report
+
+
+# -- CLI surface (shared by fedml_tpu.cli and the bare entry point) ----
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="fedml-tpu-audit")
+    add_audit_arguments(p)
+    return run_cli(p.parse_args(argv))
+
+
+def add_audit_arguments(p) -> None:
+    p.add_argument(
+        "--root", default=None,
+        help="repo root (default: auto-detected from the package "
+             "location / cwd)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline path (default: <root>/{AUDIT_BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--report", default=None,
+        help=f"where to write the static-cost report (default: "
+             f"<root>/{AUDIT_REPORT_NAME})",
+    )
+    p.add_argument(
+        "--only", action="append", default=None, metavar="NAME",
+        help="audit only this registered executable (repeatable). The "
+             "ratchet still applies, filtered to the selected "
+             "executables' baseline entries — other entries are "
+             "neither new nor stale in a subset run",
+    )
+    p.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="machine-readable output (one JSON object)",
+    )
+    p.add_argument(
+        "--ci", action="store_true",
+        help="CI gate mode: the baseline file MUST exist (a deleted "
+             "baseline must fail the gate, not silently pass a raw "
+             "run) and --update-baseline is rejected",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings "
+             "(burn-down workflow; never valid under --ci)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report raw findings without ratcheting (exit 1 if any)",
+    )
+
+
+def run_cli(args) -> int:
+    import sys
+
+    # hermetic by default: audit is a lowering-only pass, so a box with
+    # an attached accelerator must not spend device init on it (and CI
+    # wants CPU-lowered artifacts regardless of the runner). An
+    # explicit JAX_PLATFORMS always wins; a jax already imported
+    # in-process is left alone.
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        root = find_repo_root(args.root)
+    except FileNotFoundError as e:
+        print(f"audit: {e}", file=sys.stderr)
+        return 2
+    if args.ci and args.update_baseline:
+        print(
+            "audit: --ci and --update-baseline are mutually exclusive "
+            "(the CI gate ratchets; it never rewrites)", file=sys.stderr,
+        )
+        return 2
+    if args.only and args.update_baseline:
+        print(
+            "audit: --update-baseline needs a FULL run — an --only "
+            "subset would overwrite the ledger with only the subset's "
+            "findings", file=sys.stderr,
+        )
+        return 2
+    try:
+        findings, report = run_audit(only=args.only)
+    except (RuntimeError, KeyError) as e:
+        print(f"audit: {e}", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or os.path.join(root, AUDIT_BASELINE_NAME)
+
+    if not args.only:
+        report_path = args.report or os.path.join(root, AUDIT_REPORT_NAME)
+        with open(report_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+    else:
+        report_path = None
+
+    def only_filter(baseline):
+        # a subset run can only judge the executables it lowered —
+        # other specs' baseline entries are neither new nor stale
+        # here. Every audit message embeds "executable '<name>'", so
+        # filtering by that tag keeps exactly the selected specs'
+        # accepted TODOs in force (mirrors lint's path-subset
+        # semantics)
+        tags = tuple(f"executable '{n}'" for n in args.only)
+        return {
+            k: v for k, v in baseline.items()
+            if any(t in k for t in tags)
+        }
+
+    return run_ratchet_cli(
+        "audit", args, findings, baseline_path,
+        baseline_filter=only_filter if args.only else None,
+        save_comment=_BASELINE_COMMENT,
+        json_extra={
+            "root": root,
+            "report": report_path,
+            "executables": len(report["executables"]),
+        },
+        summary_prefix=f"{len(report['executables'])} lowered case(s), ",
+        summary_suffix=(f"; report -> {report_path}" if report_path else ""),
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

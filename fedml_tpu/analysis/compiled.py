@@ -1,0 +1,419 @@
+"""Compiled-artifact audit plane — the registry and AOT-lowering layer
+under ``fedml-tpu audit`` (docs/static_analysis.md).
+
+``fedml-tpu lint`` checks what the *source* says; nothing checked what
+XLA *actually lowers* — donation contracts lived in docstrings, the
+"no host transfers in hot executables" rule was enforced only at the
+Python-source level, and the compile census (one executable per pow2
+shape bucket) was asserted per-module by tests that execute training.
+This module closes that gap without executing anything:
+
+- hot-path modules REGISTER their executables via the
+  :func:`auditable` decorator — either directly on a module-level jit
+  (with an ``abstract_inputs`` builder producing
+  ``jax.ShapeDtypeStruct`` argument trees), or on a *provider*
+  function that builds the executable the same way the runtime does
+  (``build_round_fn`` / ``build_group_fn`` / ``build_forward``) and
+  returns fully-formed :class:`LoweringCase`\\s across the pow2 shape
+  census;
+- the auditor (``fedml_tpu/analysis/audit.py``) AOT-lowers every case
+  (``jit(...).lower(*abstract_args)`` — tracing only, **nothing is
+  ever executed**, no data exists) and verifies compile-time
+  invariants against the lowered StableHLO module: input–output
+  aliasing for every docstring-claimed donation, no host-transfer ops
+  in hot executables, shape-key counts within the pow2 budget, no
+  large baked-in constants, and XLA's static cost analysis
+  (FLOPs / bytes accessed) for the ``audit_report.json`` roofline.
+
+Import discipline: importing THIS module must not import JAX — the
+CLI surface (``fedml_tpu.cli``) builds its parser from the audit
+module on a bare checkout. JAX is imported lazily the moment a case
+is built or lowered; the registered host modules (which all import
+JAX at top level anyway) are imported on demand by
+:func:`load_registry`.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "AUDITED_MODULES",
+    "AuditContext",
+    "AuditableSpec",
+    "LoweredArtifact",
+    "LoweringCase",
+    "auditable",
+    "load_registry",
+    "lower_case",
+    "pow2_budget",
+]
+
+# the modules that register auditable executables; load_registry()
+# imports each so their @auditable declarations run. Growing the hot
+# path? Register the executable AND add its module here.
+AUDITED_MODULES = (
+    "fedml_tpu.core.aggregation",
+    "fedml_tpu.simulation.fedavg_api",
+    "fedml_tpu.scale.engine",
+    "fedml_tpu.serving.endpoint",
+)
+
+
+def pow2_budget(sizes: Sequence[int]) -> int:
+    """How many pow2 shape keys the span [min(sizes), max(sizes)]
+    legitimately needs — the census rule's budget (8..512 -> 7)."""
+    lo, hi = min(sizes), max(sizes)
+    return int(math.log2(max(hi, 1) // max(lo, 1))) + 1
+
+
+@dataclass
+class LoweringCase:
+    """One (executable, abstract inputs) pair — a single shape key of
+    a registered executable's census. ``fn`` must be a jit-wrapped
+    callable (it is ``.lower()``-ed, never called)."""
+
+    key: str  # census key, e.g. "b8" / "b8xnb4"
+    fn: Any
+    args: Tuple[Any, ...] = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class AuditableSpec:
+    """One registered executable: how to build its census cases and
+    which compile-time contracts its docstrings claim."""
+
+    name: str
+    path: str  # repo-relative module path (baseline key namespace)
+    provider: Callable[["AuditContext"], List[LoweringCase]]
+    # argnums the docstrings claim are donated — the lowered module
+    # must carry input-output aliasing for every leaf of these args
+    donate: Tuple[int, ...] = ()
+    # round-shaped executables (carried state in, carried state out)
+    # with ZERO aliasing are findings even without a donation claim —
+    # the ground truth behind the lint suite's donation TODOs
+    round_shaped: bool = False
+    # hot executables must contain no host-transfer ops at all
+    hot: bool = True
+    # census rule: max lowered shape keys (int, or callable(ctx) ->
+    # int); None skips the census check for this spec
+    census_budget: Any = None
+    # aot-constant rule: largest tolerated non-splat baked-in constant
+    constant_budget_bytes: int = 64 * 1024
+
+
+_REGISTRY: Dict[str, AuditableSpec] = {}
+
+
+def _module_to_path(module: str) -> str:
+    return module.replace(".", "/") + ".py"
+
+
+def auditable(
+    name: str,
+    abstract_inputs: Optional[Callable[["AuditContext"], List[Tuple]]] = None,
+    *,
+    donate: Tuple[int, ...] = (),
+    round_shaped: bool = False,
+    hot: bool = True,
+    census_budget: Any = None,
+    constant_budget_bytes: int = 64 * 1024,
+):
+    """Register an executable with the compiled-artifact auditor.
+
+    Two application forms:
+
+    - on a module-level jit, with ``abstract_inputs`` — a function
+      ``ctx -> [(case_key, args, kwargs), ...]`` of
+      ``jax.ShapeDtypeStruct`` trees; the decorated jit itself is
+      lowered for each tuple;
+    - on a *provider* function ``ctx -> [LoweringCase, ...]`` (no
+      ``abstract_inputs``) — for executables the runtime builds per
+      instance (the round fn, the planet group fn, the serving
+      forward): the provider constructs them through the same
+      module-level builders the runtime uses.
+
+    Returns the decorated object unchanged — zero runtime cost.
+    """
+
+    def register(obj):
+        if abstract_inputs is not None:
+            def provider(ctx, _fn=obj):
+                return [
+                    LoweringCase(key=k, fn=_fn, args=tuple(a), kwargs=dict(kw))
+                    for k, a, kw in abstract_inputs(ctx)
+                ]
+        else:
+            provider = obj
+        module = getattr(obj, "__module__", None) or "fedml_tpu"
+        _REGISTRY[name] = AuditableSpec(
+            name=name,
+            path=_module_to_path(module),
+            provider=provider,
+            donate=tuple(donate),
+            round_shaped=round_shaped,
+            hot=hot,
+            census_budget=census_budget,
+            constant_budget_bytes=int(constant_budget_bytes),
+        )
+        return obj
+
+    return register
+
+
+def load_registry() -> Dict[str, AuditableSpec]:
+    """Import every audited module (running their ``@auditable``
+    registrations) and return the registry. JAX loads here — never at
+    ``fedml_tpu.analysis`` import time."""
+    import importlib
+
+    for mod in AUDITED_MODULES:
+        importlib.import_module(mod)
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------
+# audit context: the shared abstract world every provider builds from
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class AuditContext:
+    """The abstract (data-free) world the census is lowered against: a
+    small real model from the zoo plus ``ShapeDtypeStruct`` factories.
+    Small on purpose — the audit's subject is compile-time structure
+    (aliasing, host ops, shape keys, cost ratios), not model scale; a
+    CPU-only box lowers the full census in seconds."""
+
+    cohort_buckets: Tuple[int, ...] = (8, 32)
+    nb_census: Tuple[int, ...] = (2, 4)
+    batch_size: int = 4
+    feature_dim: int = 8
+    class_num: int = 4
+    serve_buckets: Tuple[int, ...] = (4, 16)
+    edge_num: int = 2
+    epochs: int = 1
+    learning_rate: float = 0.03
+
+    _model: Any = field(default=None, repr=False)
+    _params: Any = field(default=None, repr=False)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cohort_buckets": list(self.cohort_buckets),
+            "nb_census": list(self.nb_census),
+            "batch_size": self.batch_size,
+            "feature_dim": self.feature_dim,
+            "class_num": self.class_num,
+            "serve_buckets": list(self.serve_buckets),
+            "edge_num": self.edge_num,
+            "epochs": self.epochs,
+        }
+
+    # -- model ---------------------------------------------------------
+    def model(self):
+        """A real zoo model (logistic regression over
+        ``feature_dim`` -> ``class_num``) — the smallest member of the
+        family every audited executable is generic over."""
+        if self._model is None:
+            from ..models.linear import LogisticRegression
+            from ..models.spec import FedModel
+
+            self._model = FedModel(
+                name="lr",
+                module=LogisticRegression(self.class_num),
+                example_shape=(self.feature_dim,),
+            )
+        return self._model
+
+    def abstract_params(self):
+        """The model's parameter pytree as ``ShapeDtypeStruct`` leaves
+        — obtained via ``jax.eval_shape`` so nothing initializes."""
+        import jax
+
+        if self._params is None:
+            self._params = jax.eval_shape(
+                self.model().init, jax.random.PRNGKey(0)
+            )
+        return self._params
+
+    def local_train_fn(self):
+        """The stock local-training fn over the audit model — built by
+        the same factory the runtime uses."""
+        import optax
+
+        from ..core.local_trainer import make_local_train_fn
+
+        model = self.model()
+        return make_local_train_fn(
+            model.apply,
+            model.loss_fn,
+            optax.sgd(self.learning_rate),
+            epochs=self.epochs,
+        )
+
+    # -- ShapeDtypeStruct factories -----------------------------------
+    def sds(self, shape, dtype="float32"):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+    def abstract_key(self):
+        """A raw uint32[2] PRNG key shape — what the round loops thread
+        through ``jax.random.split`` chains."""
+        return self.sds((2,), "uint32")
+
+    def abstract_batches(self, *lead: int):
+        """A packed ``Batches`` of ShapeDtypeStructs with the given
+        leading axes (e.g. federation size, or group client bucket)."""
+        from ..core.types import Batches
+
+        nb, bs, f = max(self.nb_census), self.batch_size, self.feature_dim
+        return Batches(
+            x=self.sds(tuple(lead) + (nb, bs, f)),
+            y=self.sds(tuple(lead) + (nb, bs), "int32"),
+            mask=self.sds(tuple(lead) + (nb, bs), "float32"),
+        )
+
+    def abstract_group_batches(self, clients: int, nb: int):
+        """Group-shaped ``Batches`` for the planet engine's
+        per-(bucket, nb) jit."""
+        from ..core.types import Batches
+
+        bs, f = self.batch_size, self.feature_dim
+        return Batches(
+            x=self.sds((clients, nb, bs, f)),
+            y=self.sds((clients, nb, bs), "int32"),
+            mask=self.sds((clients, nb, bs), "float32"),
+        )
+
+    def abstract_params_f32(self):
+        """The param tree re-typed to float32 — the fold/term currency
+        (terms and expansion limbs are always f32)."""
+        import jax
+
+        return jax.tree.map(
+            lambda a: self.sds(a.shape, "float32"), self.abstract_params()
+        )
+
+
+# ---------------------------------------------------------------------
+# lowering + artifact parsing
+# ---------------------------------------------------------------------
+
+# host-transfer vocabulary in lowered modules: python callbacks
+# (jax.debug.*, io_callback/pure_callback), infeed/outfeed, and the
+# TPU host-offload custom calls all match here
+_HOST_TRANSFER_TARGET = re.compile(
+    r"callback|host|infeed|outfeed", re.IGNORECASE
+)
+_CUSTOM_CALL = re.compile(r"custom_call\s*@([\w.]+)")
+_INFEED_OP = re.compile(r"\b(?:stablehlo|mhlo)\.(infeed|outfeed)\b")
+_ALIASING = re.compile(r"tf\.aliasing_output")
+_CONST_LINE = re.compile(
+    r"(?:stablehlo|mhlo)\.constant\s+dense<(.)"
+)
+_TENSOR_TYPE = re.compile(r"tensor<([0-9x]*)((?:[a-z][a-z0-9]*))>")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1, "i4": 1, "ui4": 1,
+}
+
+
+def _tensor_bytes(dims: str, dtype: str) -> int:
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+@dataclass
+class LoweredArtifact:
+    """Everything the four checkers need from one lowered case."""
+
+    spec_name: str
+    case_key: str
+    aliased_inputs: int  # inputs carrying tf.aliasing_output
+    claimed_donated_leaves: int  # leaves of the docstring-claimed args
+    host_transfers: List[str]  # offending op/custom-call targets
+    constants_bytes: List[int]  # NON-SPLAT baked-in constants, bytes
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+
+    @property
+    def max_constant_bytes(self) -> int:
+        return max(self.constants_bytes, default=0)
+
+
+def _parse_host_transfers(text: str) -> List[str]:
+    found = set()
+    for m in _CUSTOM_CALL.finditer(text):
+        if _HOST_TRANSFER_TARGET.search(m.group(1)):
+            found.add(m.group(1))
+    for m in _INFEED_OP.finditer(text):
+        found.add(m.group(1))
+    return sorted(found)
+
+
+def _parse_constants(text: str) -> List[int]:
+    """Byte sizes of NON-SPLAT baked-in constants. A splat
+    (``dense<0.0>``) is a compile-time fill — cheap and value-stable;
+    a bracketed/hex blob is a closure-captured concrete array: it
+    bloats the executable, occupies HBM per shape key, and a changing
+    value forces a recompile."""
+    out = []
+    for line in text.splitlines():
+        m = _CONST_LINE.search(line)
+        if m is None or m.group(1) not in ("[", '"'):
+            continue
+        tm = None
+        for tm in _TENSOR_TYPE.finditer(line):
+            pass  # the LAST tensor<> on the line is the result type
+        if tm is not None:
+            out.append(_tensor_bytes(tm.group(1), tm.group(2)))
+    return out
+
+
+def lower_case(spec: AuditableSpec, case: LoweringCase) -> LoweredArtifact:
+    """AOT-lower one case (trace only — nothing executes) and parse
+    the contracts out of the StableHLO module text."""
+    import jax
+
+    if not hasattr(case.fn, "lower"):
+        raise TypeError(
+            f"auditable '{spec.name}' case '{case.key}': fn has no "
+            ".lower() — register the jit-wrapped executable, not the "
+            "bare python function"
+        )
+    lowered = case.fn.lower(*case.args, **case.kwargs)
+    text = lowered.as_text()
+    claimed = 0
+    for i in spec.donate:
+        if i < len(case.args):
+            claimed += len(jax.tree.leaves(case.args[i]))
+    try:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+    except Exception:  # pragma: no cover - backend-dependent support
+        cost = {}
+    return LoweredArtifact(
+        spec_name=spec.name,
+        case_key=case.key,
+        aliased_inputs=len(_ALIASING.findall(text)),
+        claimed_donated_leaves=claimed,
+        host_transfers=_parse_host_transfers(text),
+        constants_bytes=_parse_constants(text),
+        flops=float(cost["flops"]) if "flops" in cost else None,
+        bytes_accessed=(
+            float(cost["bytes accessed"]) if "bytes accessed" in cost else None
+        ),
+    )

@@ -60,8 +60,12 @@ def _in_seeded_path(path: str) -> bool:
     )
 
 
-def check_determinism(mod: ModuleSource) -> List[Finding]:
-    if not _in_seeded_path(mod.path):
+def check_determinism(mod: ModuleSource, force: bool = False) -> List[Finding]:
+    """``force=True`` applies the rule regardless of the module-set
+    gate — the relaxed ``tests/`` profile (engine.py) uses it: a test
+    drawing from the global RNG is exactly how order-dependent flakes
+    are born, even though tests/ is not a shipped seeded path."""
+    if not force and not _in_seeded_path(mod.path):
         return []
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):

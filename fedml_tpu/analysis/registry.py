@@ -49,7 +49,14 @@ RUNTIME_ARGS = {
     "rank_in_node", "node_rank", "n_proc_in_silo", "silo_rank", "comm",
 }
 
-_HISTOGRAM_SUFFIXES = ("_seconds", "_s", "_ms", "_bytes", "_frac", "_total")
+# unit vocabulary for histogram names; "_rounds" is a federation-native
+# unit (staleness, probation length) just like seconds or bytes, and
+# "_ratio" is the dimensionless quotient that may exceed 1 (anomaly
+# scores) where "_frac" promises [0, 1]
+_HISTOGRAM_SUFFIXES = (
+    "_seconds", "_s", "_ms", "_bytes", "_frac", "_ratio", "_rounds",
+    "_total",
+)
 
 _EMIT_METHODS = {"inc": "counter", "set_gauge": "gauge", "observe": "histogram"}
 
@@ -308,8 +315,8 @@ def check_registry(
                 path=path, line=line, rule=RULE,
                 message=(
                     f"histogram '{name}' has no unit suffix "
-                    "(_seconds/_s/_ms/_bytes/_frac) — unitless series "
-                    "are unreadable on dashboards"
+                    "(_seconds/_s/_ms/_bytes/_frac/_ratio/_rounds) — "
+                    "unitless series are unreadable on dashboards"
                 ),
             ))
         if (kind, name) not in seen_names:

@@ -356,6 +356,74 @@ _DEFAULTS: Dict[str, Any] = {
     # register as — one misconfigured hello must not bloat the server
     # with ghost ranks
     "max_clients": 4096,
+    # ---- scenario / model-geometry knobs (schema burn-down) ---------
+    # Every knob below was read via getattr(...) with an inline
+    # fallback but had no schema entry (the lint suite's registry
+    # rule); defaults here MATCH those read-site fallbacks exactly, so
+    # unset configs behave identically. seq_len and the real-data
+    # subsample sizes keep dynamic per-site fallbacks and stay
+    # baselined.
+
+    "shuffle": True,  # reshuffle each client's examples every local epoch
+    "output_dim": 10,  # class/label count for the synthetic-style loaders
+    "synthetic_feature_dim": 2000,  # synthetic-fedprox feature width
+    "synthetic_sigma": 1.0,  # synthetic feature noise scale
+    "synthetic_alpha": 1.0,  # fedprox-synthetic u_k spread
+    "synthetic_beta": 1.0,  # fedprox-synthetic v_k spread
+    "vocab_size": 0,  # LM vocabulary (0 = the model family's default)
+    "num_layers": 2,  # transformer depth
+    "num_heads": 4,  # attention heads
+    "embed_dim": 128,  # transformer model width
+    "max_len": 512,  # positional-embedding capacity
+    "hidden_dim": 64,  # MLP hidden width
+    "attention_impl": "full",  # "full" | "segsum" (seg_width panels)
+    "seg_width": 32,  # segsum attention panel width
+    "moe_every": 2,  # every Nth transformer block is a Switch MoE layer
+    "num_experts": 8,  # Switch MoE expert count
+    "capacity_factor": 1.25,  # MoE per-expert token capacity slack
+    "nas_width": 16,  # FedNAS stem channels
+    "nas_cells": 2,  # FedNAS cells per client model
+    "nas_steps": 2,  # FedNAS nodes per cell
+    "arch_learning_rate": 0.0003,  # FedNAS architecture-weight LR
+    "gan_latent_dim": 64,  # FedGAN generator latent size
+    "gan_lr_g": 0.0002,  # FedGAN generator LR
+    "gan_lr_d": 0.0002,  # FedGAN discriminator LR
+    "splitnn_stages": (1, 1, 1),  # SplitNN (client, server, head) depths
+    "vfl_parties": 2,  # vertical-FL feature-holding parties
+    "gkt_server_stages": (2, 2, 2),  # FedGKT server tower depths
+    "gkt_alpha": 1.0,  # FedGKT distillation loss weight
+    "gkt_temperature": 3.0,  # FedGKT softmax temperature
+    "gkt_server_epochs": 1,  # FedGKT server epochs per round
+    "group_num": 2,  # hierarchical-FL group count
+    "group_method": "random",  # hierarchical-FL grouping rule
+    "group_comm_round": 1,  # hierarchical-FL intra-group rounds
+    "topology_neighbor_num": 2,  # decentralized ring/random neighbors
+    "topology_beta": 0.0,  # PushSum topology asymmetry
+    "ta_groups": 4,  # TurboAggregate circular groups
+    "ta_quant_scale": 65536.0,  # TurboAggregate additive-share scale
+    "sfedavg_alpha": 0.5,  # S-FedAvg reputation weight (goodness)
+    "sfedavg_beta": 0.5,  # S-FedAvg reputation weight (history)
+    "sampling_filter": "exp",  # S-FedAvg score->probability filter
+    "score_method": "acc",  # S-FedAvg client scoring signal
+    "sv_tol": 0.005,  # Shapley truncation tolerance
+    "valid_batches": 4,  # validation batches for defense scoring
+    "hs_L": 0.0,  # HS-FedAvg FFT band (0 = derive from the input)
+    "hs_momentum": 0.1,  # HS-FedAvg spectral-mask momentum
+    "server_beta1": 0.9,  # FedOpt adam/yogi first-moment decay
+    "server_beta2": 0.999,  # FedOpt adam/yogi second-moment decay
+    "broker_host": "127.0.0.1",  # MQTT broker bind address
+    "broker_port": 0,  # MQTT broker port (0 = per-run local broker)
+    "trpc_ipconfig_path": None,  # TRPC fabric rank->ip CSV
+    "trpc_port_base": None,  # TRPC first port (rank k = base+k)
+    "payload_store_dir": None,  # spill oversized comm payloads here
+    "log_metrics": True,  # mirror server metrics into the run log
+    "metrics_jsonl_path": None,  # also append metrics as JSONL here
+    # cross-device control plane (cross_device/server.py)
+    "cross_device_backend": constants.COMM_BACKEND_MQTT,
+    "silo_backend": "LOCAL",  # hierarchical cross-silo in-silo fabric
+    "silo_grpc_port_base": 9890,  # in-silo gRPC first port
+    "silo_grpc_ipconfig_path": None,  # in-silo rank->ip CSV
+    "silo_device_count": 0,  # devices per silo (0 = all local devices)
 }
 
 _SECTIONS = (

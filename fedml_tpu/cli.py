@@ -38,6 +38,15 @@ dependency):
   ratcheted against the checked-in ``lint_baseline.json`` (CI fails
   on any NEW finding and on stale suppressions). Pure AST: no JAX
   import, runs in seconds on a bare checkout.
+- ``audit``    — beyond the reference: the compiled-artifact
+  counterpart of ``lint`` (``fedml_tpu/analysis/compiled.py`` +
+  ``audit.py``): AOT-lowers every registered hot-path executable
+  (round fn, aggregation term/fold jits, planet group jit, serving
+  forward) across the pow2 shape census — nothing executes — and
+  verifies donation aliasing, host-transfer freedom, census size and
+  baked-constant budgets against the ratcheted
+  ``audit_baseline.json``, emitting the ``audit_report.json``
+  FLOPs/bytes roofline.
 
 State lives under ``~/.fedml_tpu/`` (override: FEDML_TPU_HOME).
 """
@@ -320,6 +329,18 @@ def cmd_lint(args) -> int:
     return run_cli(args)
 
 
+def cmd_audit(args) -> int:
+    """Run the compiled-artifact audit (docs/static_analysis.md):
+    AOT-lower every registered hot-path executable (nothing executes)
+    and verify donation aliasing, host-transfer freedom, the pow2
+    shape census and baked-constant budgets against the ratcheted
+    audit_baseline.json, emitting the audit_report.json static-cost
+    roofline. Needs JAX (unlike `lint`); lowers for CPU by default."""
+    from .analysis.audit import run_cli
+
+    return run_cli(args)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fedml-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -381,6 +402,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_lint_arguments(lint)
     lint.set_defaults(fn=cmd_lint)
+
+    audit = sub.add_parser("audit")
+    from .analysis.audit import add_audit_arguments
+
+    add_audit_arguments(audit)
+    audit.set_defaults(fn=cmd_audit)
 
     build = sub.add_parser("build")
     build.add_argument("-t", "--type", required=True, choices=["client", "server"])

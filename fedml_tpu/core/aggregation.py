@@ -26,8 +26,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import constants
+from ..analysis.compiled import auditable
 
 Params = Any  # pytree of jax.Array
+
+
+# -- compiled-artifact audit (fedml_tpu/analysis/compiled.py) ---------
+# Abstract-input builders for the registered term/fold executables:
+# `fedml-tpu audit` AOT-lowers each one against these ShapeDtypeStruct
+# trees (no data, nothing executed) and verifies donation aliasing /
+# host-transfer-freedom / baked-constant budgets on the lowered HLO.
+# The encoded/decoded codec variants are not registered: their static
+# codec argument binds a live instance, and they lower to the same
+# fold currency these cover.
+
+def _audit_term_inputs(ctx):
+    p = ctx.abstract_params_f32()
+    return [("model", (p, ctx.sds((), "float32")), {})]
+
+
+def _audit_term_clipped_inputs(ctx):
+    p = ctx.abstract_params_f32()
+    s = ctx.sds((), "float32")
+    return [("model", (p, p, s, s), {})]
+
+
+def _audit_delta_term_clipped_inputs(ctx):
+    p = ctx.abstract_params_f32()
+    s = ctx.sds((), "float32")
+    return [("model", (p, s, s), {})]
+
+
+def _audit_fold_inputs(ctx):
+    p = ctx.abstract_params_f32()
+    return [("model", ((p, p, p), p), {})]
 
 
 def stack_pytrees(trees: Sequence[Params]) -> Params:
@@ -172,11 +204,21 @@ def _fold_leaf(s0, s1, s2, t):
     return s0, s1, s2
 
 
-@jax.jit
+@auditable(
+    "agg.fold_tree", _audit_fold_inputs, donate=(0,), round_shaped=True,
+)
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _fold_tree(limbs, term: Params):
     """Exact expansion fold of an already-weighted term tree. Adds
     only — keep any multiply (term computation) OUT of this jit, or
-    XLA's FMA contraction breaks the error-free transformation."""
+    XLA's FMA contraction breaks the error-free transformation.
+
+    ``limbs`` is DONATED (audited by ``fedml-tpu audit``): every call
+    site rebinds ``self._limbs = _fold_tree(self._limbs, ...)``, so the
+    old expansion buffers are dead the moment the fold dispatches —
+    XLA updates the 3-limb accumulators in place instead of allocating
+    a fresh O(model) triple per upload. ``term`` is NOT donated: merge
+    folds another live accumulator's limbs through this argument."""
     s0, s1, s2 = limbs
     out = jax.tree.map(_fold_leaf, s0, s1, s2, term)
     # tree-of-triples -> triple-of-trees (transpose keeps arbitrary
@@ -187,6 +229,7 @@ def _fold_tree(limbs, term: Params):
     )
 
 
+@auditable("agg.weighted_term", _audit_term_inputs)
 @jax.jit
 def _weighted_term(theta: Params, w: jax.Array) -> Params:
     """t = w * theta, rounded once per upload — deterministic per
@@ -251,6 +294,7 @@ def _clip_scale(norm: jax.Array, bound: jax.Array) -> jax.Array:
     return jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
 
 
+@auditable("agg.weighted_term_clipped", _audit_term_clipped_inputs)
 @jax.jit
 def _weighted_term_clipped(
     theta: Params, g: Params, bound: jax.Array, w: jax.Array
@@ -288,6 +332,9 @@ def _weighted_term_encoded_clipped(
     return term, norm, norm > bound
 
 
+@auditable(
+    "agg.weighted_delta_term_clipped", _audit_delta_term_clipped_inputs,
+)
 @jax.jit
 def _weighted_delta_term_clipped(delta: Params, bound: jax.Array, w: jax.Array):
     """Async-mode clip: the fold currency is the delta itself, so the

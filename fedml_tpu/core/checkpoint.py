@@ -115,6 +115,9 @@ class RoundCheckpointer:
 
         def _publish() -> None:
             self.manager.save(
+                # `self._ocp.args` is ORBAX's args module, not the
+                # federation knob schema
+                # lint: registry-ok — orbax CheckpointArgs namespace
                 round_idx, args=self._ocp.args.StandardSave(state)
             )
             self.manager.wait_until_finished()
@@ -163,6 +166,7 @@ class RoundCheckpointer:
 
             state = self.manager.restore(
                 step,
+                # lint: registry-ok — orbax CheckpointArgs namespace
                 args=self._ocp.args.StandardRestore(jax.tree.map(to_ref, target)),
             )
         else:
@@ -170,6 +174,7 @@ class RoundCheckpointer:
             # manager.restore(step) ("provide CheckpointArgs"); the
             # target-free form restores the raw saved tree (host numpy)
             state = self.manager.restore(
+                # lint: registry-ok — orbax CheckpointArgs namespace
                 step, args=self._ocp.args.StandardRestore()
             )
         logging.info("checkpoint restored from round %d", step)

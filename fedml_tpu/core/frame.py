@@ -94,6 +94,11 @@ class ClientTrainer(abc.ABC):
 
         args = args if args is not None else self.args
         if self._jitted_train is None or args is not self._jitted_train_args:
+            # donation deliberately withheld: self.params may be a
+            # zero-copy LOCAL-backend broadcast SHARED by every
+            # in-process trainer of the world — donating it here would
+            # invalidate the tree a sibling client still trains from
+            # lint: donation-ok — shared zero-copy params (see above)
             self._jitted_train = jax.jit(self.make_train_fn(args))
             self._jitted_train_args = args
         # distinct key per (trainer id, call #): repeated round calls

@@ -332,7 +332,7 @@ class FedMLAggregator:
             d, running, staleness=staleness
         )
         self._tel.observe(
-            "defense_anomaly_score", score,
+            "defense_anomaly_score_ratio", score,
             buckets=(0.05, 0.1, 0.2, 0.4, 0.8, 1.6),
         )
         if self.screen.observe(index, score, norm):

@@ -1285,7 +1285,7 @@ class FedMLServerManager(ServerManager):
                 }
             )
             self.telemetry.observe(
-                "agg_staleness", staleness, buckets=(0, 1, 2, 4, 8, 16)
+                "agg_staleness_rounds", staleness, buckets=(0, 1, 2, 4, 8, 16)
             )
             if len(self._folded_since_publish) >= self.async_publish_every:
                 self._async_publish()

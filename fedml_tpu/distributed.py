@@ -320,7 +320,13 @@ class DistributedTrainer:
         self._place_data = lambda b: jax.tree.map(
             lambda a: place_global(a, NamedSharding(self.mesh, batch_spec)), b
         )
-        self._epoch = jax.jit(self._epoch_scanner(self._apply_with_aux))
+        self._epoch = jax.jit(
+            # carried (params, opt_state) donated: the epoch loop
+            # rebinds both every call, so XLA updates in place
+            # instead of copying the model per epoch (audited)
+            self._epoch_scanner(self._apply_with_aux),
+            donate_argnums=(0, 1),
+        )
         self._eval_apply = self.model.apply
 
     # -- sequence: sp (ring / Ulysses attention) ----------------------
@@ -385,7 +391,13 @@ class DistributedTrainer:
             )
 
         self._place_data = place
-        self._epoch = jax.jit(self._epoch_scanner(self._apply_with_aux))
+        self._epoch = jax.jit(
+            # carried (params, opt_state) donated: the epoch loop
+            # rebinds both every call, so XLA updates in place
+            # instead of copying the model per epoch (audited)
+            self._epoch_scanner(self._apply_with_aux),
+            donate_argnums=(0, 1),
+        )
         self._eval_apply = self.model.apply
 
     # -- pipeline: pp (GPipe over the block stack) --------------------
@@ -451,7 +463,9 @@ class DistributedTrainer:
             self._epoch_scanner(
                 # pp rejects MoE modules, so there is no aux loss here
                 lambda p, x: (self._pp_apply(p, x), jnp.float32(0.0))
-            )
+            ),
+            # same carried-state donation contract as the other builds
+            donate_argnums=(0, 1),
         )
         self._eval_apply = self._pp_apply
 

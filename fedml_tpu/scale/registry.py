@@ -97,7 +97,7 @@ class ClientRegistry:
         from ..core.telemetry import Telemetry
 
         Telemetry.get_instance().set_gauge(
-            "registry_clients_total", self.size
+            "registry_clients", self.size
         )
 
     # -- column synthesis ---------------------------------------------

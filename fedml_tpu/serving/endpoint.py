@@ -27,9 +27,52 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ModelEndpoint"]
+from ..analysis.compiled import auditable, pow2_budget
+
+__all__ = ["ModelEndpoint", "build_forward"]
 
 Params = Any
+
+
+@auditable(
+    "serving.forward",
+    census_budget=lambda ctx: pow2_budget(ctx.serve_buckets),
+)
+def _audit_forward_cases(ctx):
+    """`fedml-tpu audit` provider: the EXACT served forward the
+    endpoint jits, lowered across the serve-bucket census. No
+    donation claim (the served params persist across requests); the
+    hot rule proves a request can never stall on a host transfer."""
+    from ..analysis.compiled import LoweringCase
+
+    fn = jax.jit(build_forward(ctx.model().apply))
+    params = ctx.abstract_params()
+    return [
+        LoweringCase(
+            key=f"b{b}",
+            fn=fn,
+            args=(params, ctx.sds((b, ctx.feature_dim), "float32")),
+        )
+        for b in ctx.serve_buckets
+    ]
+
+
+def build_forward(apply_fn, on_trace=None):
+    """The served forward pass, as a pure function of the model's
+    ``apply``. Module-level so the jitted body never closes over the
+    endpoint (mutable-``self`` retrace hazard) and so the
+    compiled-artifact auditor can AOT-lower the exact served
+    computation across the serve-bucket census without an endpoint.
+    ``on_trace(bucket)`` fires at TRACE time only — the per-bucket
+    compile-count seam; it is not part of the lowered module. Returns
+    the UNjitted function; callers own the ``jax.jit``."""
+
+    def fwd(p, x):
+        if on_trace is not None:
+            on_trace(int(x.shape[0]))
+        return apply_fn(p, x)
+
+    return fwd
 
 
 def _tree_spec(tree):
@@ -56,8 +99,7 @@ class ModelEndpoint:
         # regression surface for tests/bench, like _round_trace_count
         self.trace_counts: Dict[int, int] = {}
 
-        def fwd(p, x):
-            bucket = int(x.shape[0])
+        def on_trace(bucket: int) -> None:
             self.trace_counts[bucket] = self.trace_counts.get(bucket, 0) + 1
             from ..core.telemetry import Telemetry
 
@@ -70,9 +112,8 @@ class ModelEndpoint:
                 tel.recorder.instant(
                     "serve.jit_trace", cat="compile", bucket=bucket
                 )
-            return self.model.apply(p, x)
 
-        self._fwd = jax.jit(fwd)
+        self._fwd = jax.jit(build_forward(self.model.apply, on_trace))
 
     # -- inference -----------------------------------------------------
     def params(self) -> Params:

@@ -11,7 +11,7 @@ Telemetry (all host-side, the core/telemetry.py hot-loop contract):
 
 - ``serving_request_latency_s`` — submit-to-complete histogram with
   explicit buckets (Prometheus ``_bucket``/``_sum``/``_count``);
-- ``serving_batch_occupancy`` — real rows / bucket rows per batch (how
+- ``serving_batch_occupancy_frac`` — real rows / bucket rows per batch (how
   much of each compiled shape is doing useful work);
 - ``serving_queue_depth`` gauge, ``serving_requests_total`` /
   ``serving_batches_total{bucket}`` / ``serving_shed_total{reason}``
@@ -308,7 +308,7 @@ class ServingEngine:
         if tel.enabled:
             tel.inc("serving_batches_total", bucket=bucket)
             tel.observe(
-                "serving_batch_occupancy", n / max(bucket, 1),
+                "serving_batch_occupancy_frac", n / max(bucket, 1),
                 buckets=OCCUPANCY_BUCKETS,
             )
             tel.heartbeat("serving.batch", bucket)

@@ -68,24 +68,34 @@ def extract_entries():
         if isinstance(n, ast.AnnAssign)
         and getattr(n.target, "id", None) == "_DEFAULTS"
     )
-    # comments by line number
+    # comments by line number; full-line comments tracked separately —
+    # only those may join a knob's block description (an INLINE comment
+    # belongs to ITS OWN entry's value line and must never bleed into
+    # the next knob's doc as the block walk climbs)
     comments = {}
+    full_line = set()
+    lines = source.splitlines()
     for tok in tokenize.generate_tokens(io.StringIO(source).readline):
         if tok.type == tokenize.COMMENT:
             comments[tok.start[0]] = tok.string.lstrip("# ").rstrip()
+            if lines[tok.start[0] - 1].lstrip().startswith("#"):
+                full_line.add(tok.start[0])
 
     from fedml_tpu import constants
 
     entries = []
     for key_node, val_node in zip(assign.value.keys, assign.value.values):
-        # block comment: contiguous comment lines directly above the key
+        # block comment: contiguous FULL-LINE comment lines directly
+        # above the key (inline comments belong to the entry above)
         block, line = [], key_node.lineno - 1
-        while line in comments:
+        while line in full_line:
             block.insert(0, comments[line])
             line -= 1
-        # single-word section markers ("# data") are layout, not docs
+        # single-word section markers ("# data") and ruled section
+        # headers ("# ---- ... ----") are layout, not docs
         if len(block) == 1 and len(block[0].split()) == 1:
             block = []
+        block = [b for b in block if not b.startswith("--")]
         # inline comment on the value's own line(s)
         inline = comments.get(val_node.end_lineno)
         if inline and val_node.end_lineno > key_node.lineno - 1:

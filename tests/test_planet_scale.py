@@ -149,7 +149,7 @@ class TestClientRegistry:
         Telemetry.reset()
         ClientRegistry(12_345, seed=0)
         snap = Telemetry.get_instance().snapshot()
-        assert snap["gauges"]["registry_clients_total"] == 12_345
+        assert snap["gauges"]["registry_clients"] == 12_345
 
 
 class TestCohortPacking:

@@ -444,7 +444,7 @@ class TestServingTelemetry:
             r"serving_request_latency_s_count\{[^}]*\} ([\d.]+)", text
         )
         assert inf and cnt and float(inf.group(1)) == float(cnt.group(1)) == 3.0
-        assert "serving_batch_occupancy" in text
+        assert "serving_batch_occupancy_frac" in text
 
     def test_engine_spans_exported_with_matched_begin_end(self, tmp_path):
         """Satellite 4b: serve.batch spans land in trace.json with
