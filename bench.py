@@ -81,7 +81,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
     "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
-    "chaosplan", "planet",
+    "chaosplan", "planet", "hier",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -2500,6 +2500,277 @@ def run_planet(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_hier(on_cpu: bool, smoke: bool = False) -> dict:
+    """Hierarchical server plane phase (docs/hierarchical.md): edge
+    aggregators as REAL ranks over the comm seam.
+
+    Three sections, every world's artifacts re-verified by the
+    multi-tier ``InvariantChecker``:
+
+    - **scaling** — worlds at ``edge_num`` ∈ {1, 2, 4} with a fixed
+      per-edge client count and a DELIBERATELY SLOW root link (a
+      scheduled chaos delay on every edge→root merge upload): the
+      slow link is the fixed per-round cost, the edges multiply how
+      many client uploads are folded per round at that cost, so
+      uploads/s (clients folded per steady-round wall second,
+      telemetry-counted) must scale ≥2x from 1 to 4 edges;
+    - **bit identity** — the 2-edge world's final params vs a flat
+      single-server world of the SAME clients: ``max_abs_diff == 0.0``
+      (the ``StreamingAccumulator.merge`` contract across processes);
+    - **edge kill/restart** — drop+dup faults + a scheduled
+      ``kill_client`` at edge 1's ``edge.merge_upload`` barrier
+      mid-round; a fresh edge incarnation resumes via RESYNC + its WAL
+      sub-ledger and the world still lands bit-identical to flat with
+      the checker green.
+
+    ``smoke`` (CI gate): 3 clients/edge x 3 rounds on the LR mini
+    cohort; same choreography in seconds."""
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.invariants import InvariantChecker
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_silo import Client, Server
+    from fedml_tpu.cross_silo.hierarchical import (
+        HierEdge,
+        run_local_hier_world,
+    )
+    from fedml_tpu.data import load
+
+    per_edge = 3 if (smoke or on_cpu) else 4
+    rounds = 3 if (smoke or on_cpu) else 4
+    train_size = 240 if smoke else 400
+    delay_s = 1.0  # the deliberately slow root link, per merge upload
+    edge_counts = (1, 2, 4)
+
+    def mk_base(rank, run_id, n_clients, **kw):
+        a = Arguments()
+        a.training_type = "cross_silo"
+        a.backend = "LOCAL"
+        a.dataset = "mnist"
+        a.synthetic_train_size = train_size
+        a.synthetic_test_size = 60
+        a.model = "lr"
+        a.partition_method = "hetero"
+        a.client_num_in_total = n_clients
+        a.client_num_per_round = n_clients
+        a.comm_round = rounds
+        a.epochs = 1
+        a.batch_size = 16
+        a.learning_rate = 0.1
+        a.frequency_of_the_test = rounds
+        a.shuffle = False
+        a.run_id = run_id
+        a.rank = rank
+        for k, v in kw.items():
+            setattr(a, k, v)
+        a._validate()
+        a = fedml_tpu.init(a)
+        ds = load(a)
+        m = models.create(a, ds.class_num)
+        return a, ds, m
+
+    def check_world(ck, td):
+        rep = InvariantChecker(telemetry_dir=td, checkpoint_dir=ck).check()
+        if not rep.ok:
+            _progress(f"hier: INVARIANT VIOLATIONS {rep.to_dict()}")
+        return rep.ok
+
+    out = {
+        "per_edge_clients": per_edge,
+        "rounds": rounds,
+        "root_link_delay_s": delay_s,
+        "edges": {},
+    }
+    all_checks = []
+
+    # -- scaling: E in {1,2,4}, slow root link ------------------------
+    e2_params = None
+    for e_num in edge_counts:
+        n = per_edge * e_num
+        Telemetry.reset()
+        ck = _tempfile.mkdtemp(prefix=f"bench_hier_ck{e_num}_")
+        td = _tempfile.mkdtemp(prefix=f"bench_hier_td{e_num}_")
+        # one scheduled delay per merge upload: the Nth matching send
+        # of the edge-report type fires the Nth step — every report of
+        # every round crosses the slow link
+        from fedml_tpu import constants as C
+
+        schedule = [
+            {
+                "at": {
+                    "event": "send",
+                    "msg_type": C.MSG_TYPE_E2R_EDGE_REPORT,
+                    "occurrence": k,
+                },
+                "fault": {"kind": "delay", "delay_s": delay_s},
+            }
+            for k in range(1, e_num * rounds + 1)
+        ]
+        kw = dict(
+            edge_plane="ranks",
+            edge_num=e_num,
+            checkpoint_dir=ck,
+            telemetry_dir=td,
+            chaos_schedule=schedule,
+        )
+
+        def mk(role, rank, _rid=f"bench_hier_e{e_num}", _n=n, _kw=kw):
+            return mk_base(rank, _rid, _n, **_kw)
+
+        t0 = time.perf_counter()
+        world = run_local_hier_world(mk, n, e_num)
+        wall = time.perf_counter() - t0
+        tel = Telemetry.get_instance()
+        folded = sum(
+            tel.counters_matching("hier_uploads_folded_total").values()
+        )
+        walls = world["root"].manager.round_walls
+        # steady-state: round 0 pays every client trainer's first jit
+        steady_walls = walls[1:] if len(walls) > 1 else walls
+        steady_uploads = folded - n if len(walls) > 1 else folded
+        ups = steady_uploads / max(sum(steady_walls), 1e-9)
+        ok = check_world(ck, td)
+        all_checks.append(ok)
+        out["edges"][str(e_num)] = {
+            "clients": n,
+            "uploads_folded": folded,
+            "uploads_per_sec": round(ups, 3),
+            "round_walls_s": [round(w, 3) for w in walls],
+            "world_wall_s": round(wall, 2),
+            "merges": sum(
+                tel.counters_matching("hier_edge_merges_total").values()
+            ),
+            "check_ok": ok,
+        }
+        _progress(
+            f"hier: E={e_num} ({n} clients): {ups:.2f} uploads/s, "
+            f"walls {[round(w, 2) for w in walls]}, check_ok={ok}"
+        )
+        if e_num == 2:
+            e2_params = jax.tree.map(
+                np.asarray,
+                world["root"].aggregator.get_global_model_params(),
+            )
+    ups1 = out["edges"]["1"]["uploads_per_sec"]
+    ups4 = out["edges"]["4"]["uploads_per_sec"]
+    out["uploads_scaling_e4_vs_e1"] = round(ups4 / max(ups1, 1e-9), 3)
+
+    # -- bit identity vs the flat single-server world -----------------
+    n_id = per_edge * 2
+    Telemetry.reset()
+    a0, ds0, m0 = mk_base(0, "bench_hier_flat", n_id)
+    server = Server(a0, None, ds0, m0)
+    clients = []
+    for r in range(1, n_id + 1):
+        a, ds, m = mk_base(r, "bench_hier_flat", n_id)
+        clients.append(Client(a, None, ds, m))
+    threads = [
+        threading.Thread(target=c.run, daemon=True, name=f"hierflat-c{i}")
+        for i, c in enumerate(clients)
+    ]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=120)
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("hier: flat reference world hung")
+    flat_params = jax.tree.map(
+        np.asarray, server.aggregator.get_global_model_params()
+    )
+    diff = max(
+        float(np.max(np.abs(x - y)))
+        for x, y in zip(jax.tree.leaves(flat_params), jax.tree.leaves(e2_params))
+    )
+    out["hier_vs_flat_max_abs_diff"] = diff
+    out["hier_identical_to_flat"] = diff == 0.0
+    _progress(f"hier: tree-over-ranks vs flat max abs diff {diff}")
+
+    # -- mid-round edge kill/restart under drop+dup faults ------------
+    Telemetry.reset()
+    ck = _tempfile.mkdtemp(prefix="bench_hier_kck_")
+    td = _tempfile.mkdtemp(prefix="bench_hier_ktd_")
+    kill_kw = dict(
+        edge_plane="ranks",
+        edge_num=2,
+        checkpoint_dir=ck,
+        telemetry_dir=td,
+        # beats are the restarted edge's reconnect probe (it must
+        # relearn its clients are online); deaths are healed by the
+        # restart, not declared
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=60.0,
+        reliable_comm=True,
+        comm_retry_max=8,
+        comm_retry_base_s=0.05,
+        fault_injection={"drop_prob": 0.2, "duplicate_prob": 0.2},
+        chaos_schedule=[
+            {
+                "at": {
+                    "event": "barrier",
+                    "name": "edge.merge_upload",
+                    "rank": 1,
+                    "occurrence": 1,
+                },
+                "fault": {"kind": "kill_client"},
+            }
+        ],
+    )
+
+    def mk_kill(role, rank):
+        return mk_base(rank, "bench_hier_kill", n_id, **kill_kw)
+
+    restarted = threading.Event()
+
+    def edge_wrapper(rank, edge):
+        if rank != 1:
+            return edge.run
+
+        def run_and_restart():
+            from fedml_tpu.core.chaos import ProcessKilled
+
+            try:
+                edge.run()
+            except ProcessKilled:
+                time.sleep(0.3)
+                a2, ds2, m2 = mk_kill("edge", 1)
+                restarted.set()
+                HierEdge(a2, None, ds2, m2, partition=edge.partition).run()
+
+        return run_and_restart
+
+    world = run_local_hier_world(mk_kill, n_id, 2, edge_wrapper=edge_wrapper)
+    kill_params = jax.tree.map(
+        np.asarray, world["root"].aggregator.get_global_model_params()
+    )
+    kdiff = max(
+        float(np.max(np.abs(x - y)))
+        for x, y in zip(
+            jax.tree.leaves(flat_params), jax.tree.leaves(kill_params)
+        )
+    )
+    kok = check_world(ck, td)
+    all_checks.append(kok)
+    out["edge_kill_fired"] = restarted.is_set()
+    out["edge_kill_max_abs_diff"] = kdiff
+    out["edge_kill_check_ok"] = kok
+    out["invariants_ok_all"] = all(all_checks)
+    _progress(
+        f"hier: edge kill/restart recovered (diff {kdiff}, check {kok}); "
+        f"scaling E4/E1 = {out['uploads_scaling_e4_vs_e1']}x"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
     """Tracing phase (docs/observability.md): a LOCAL multi-client
     cross-silo world run twice — telemetry OFF, then distributed
@@ -2889,6 +3160,11 @@ _CHAOSPLAN_TIMEOUT_S = 420.0
 # pairs; registry/cohort work is numpy-light, the window is for the
 # per-(bucket, nb) jit compiles on a cold box
 _PLANET_TIMEOUT_S = 420.0
+# five LOCAL worlds (E in {1,2,4} scaling with a 1s-per-merge slow
+# root link, the flat identity reference, the edge kill/restart world)
+# — mini LR cohorts; the slow link adds rounds x 1s per scaling world
+# on top of cold-box jit compiles
+_HIER_TIMEOUT_S = 480.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -3187,6 +3463,12 @@ def _main_guarded() -> None:
     # size, two-tier tree aggregation bit-identical to flat, and the
     # compile-trace census within the pow2 bucket budget
     _run_demoted_phase("planet", _PLANET_TIMEOUT_S)
+    # hierarchical server plane phase (edge aggregators as real ranks):
+    # uploads/s scaling vs edge count under a deliberately slow root
+    # link, tree-over-ranks bit-identical to the flat single-server
+    # world, and a mid-round edge kill/restart recovering with the
+    # multi-tier invariant checker green
+    _run_demoted_phase("hier", _HIER_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -3338,6 +3620,8 @@ def _phase_main(argv) -> None:
         out = run_chaosplan(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "planet":
         out = run_planet(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "hier":
+        out = run_hier(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -127,3 +127,16 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_planet_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Hierarchical server plane smoke (3 clients/edge, edge_num 1/2/4,
+# 3 rounds, CPU): edge aggregators as real ranks must run end-to-end
+# through bench.py's hier phase child and emit the detail.hier
+# contract keys — uploads/s scaling >= 2x from 1 to 4 edges under the
+# deliberately slow root link (one scheduled delay per merged limb-set
+# crossing the edge->root hop), tree-over-ranks final params
+# bit-identical to the flat single-server world, and a mid-round edge
+# kill/restart recovering bit-identically with the multi-tier
+# InvariantChecker green on every world's artifacts.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_hier_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -225,6 +225,18 @@ _DEFAULTS: Dict[str, Any] = {
     # to flat aggregation. Applies to the registry-backed simulator AND
     # the cross-silo streaming server (agg_mode=stream). 0/1 = flat
     "edge_num": 0,
+    # hierarchical server plane (cross_silo/hierarchical — docs/
+    # hierarchical.md): "inproc" keeps the edge tier inside the server
+    # process (the PR 9 tree); "ranks" promotes the edge_num edges to
+    # REAL ranks over the comm seam — clients upload to their assigned
+    # edge, each edge streams-folds + screens locally and ships one
+    # merged limb-set per round close, the root merges bit-identically
+    # to flat. Requires training_type=cross_silo + agg_mode=stream
+    "edge_plane": "inproc",
+    # gRPC port stride between per-edge client fabrics (each fabric
+    # binds grpc_port_base + edge_rank * stride + rank); must exceed
+    # the client count. LOCAL fabrics are name-strided and ignore it
+    "hier_port_stride": 64,
     # back the registry columns with .npy memmaps under this directory
     # instead of host RAM (None = in-RAM numpy)
     "registry_dir": None,
@@ -816,6 +828,70 @@ class Arguments:
                     f"edge_num={self.edge_num} exceeds the cohort size "
                     f"{cohort}: an edge tier wider than its cohort is a "
                     "misconfiguration, not a topology"
+                )
+        # -- hierarchical server plane (cross_silo/hierarchical) -------
+        plane = str(getattr(self, "edge_plane", "inproc") or "inproc")
+        if plane not in ("inproc", "ranks"):
+            raise ValueError(
+                f"edge_plane={plane!r}: pick 'inproc' (the in-process "
+                "tree) or 'ranks' (edge aggregators as real ranks)"
+            )
+        self.edge_plane = plane
+        raw_stride = getattr(self, "hier_port_stride", 64)
+        try:
+            self.hier_port_stride = int(
+                64 if raw_stride is None else raw_stride
+            )
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"hier_port_stride={raw_stride!r}: must be an integer"
+            ) from None
+        if self.hier_port_stride < 1:
+            raise ValueError(
+                f"hier_port_stride={self.hier_port_stride}: must be >= 1"
+            )
+        if plane == "ranks":
+            if t != constants.FEDML_TRAINING_PLATFORM_CROSS_SILO:
+                raise ValueError(
+                    "edge_plane=ranks needs training_type=cross_silo "
+                    f"(real edge processes over the comm seam); got {t!r}"
+                )
+            if getattr(self, "agg_mode", "stream") != "stream":
+                raise ValueError(
+                    "edge_plane=ranks requires agg_mode=stream: the edge "
+                    "tier IS the streaming fold (one merged limb-set per "
+                    "round crosses the root link); buffered has no "
+                    "limb-set to ship and async hierarchy is ROADMAP work"
+                )
+            if self.edge_num < 1:
+                raise ValueError(
+                    f"edge_plane=ranks needs edge_num >= 1; got "
+                    f"{self.edge_num}"
+                )
+            if self.edge_num > int(self.client_num_per_round):
+                raise ValueError(
+                    f"edge_num={self.edge_num} exceeds "
+                    f"client_num_per_round={self.client_num_per_round}: an "
+                    "edge tier wider than its clients is a "
+                    "misconfiguration, not a topology"
+                )
+            if getattr(self, "defense_type", None) == constants.DEFENSE_MEDIAN:
+                raise ValueError(
+                    "edge_plane=ranks cannot run defense_type=median: a "
+                    "full-cohort reduction needs every upload in one "
+                    "place, which is exactly what the edge tier removes"
+                )
+            if bool(getattr(self, "elastic_membership", False)):
+                raise ValueError(
+                    "edge_plane=ranks does not support elastic_membership "
+                    "yet: the client->edge partition is planned per run "
+                    "(joins would need repartitioning)"
+                )
+            if float(getattr(self, "aggregation_deadline_s", 0) or 0) > 0:
+                raise ValueError(
+                    "edge_plane=ranks closes rounds per edge and uses the "
+                    "quorum close at the root (round_quorum_frac/"
+                    "round_grace_s); aggregation_deadline_s does not apply"
                 )
 
     # -- niceties ------------------------------------------------------

@@ -17,6 +17,11 @@ dependency):
   external MLOps serving tier): stand up the TPU-native serving plane
   (``fedml_tpu/serving``) for the federated global model, hot-swapping
   weights from a checkpoint dir as the trainer publishes new rounds.
+- ``edge``     — beyond the reference: launch one edge aggregator rank
+  of the hierarchical server plane (``cross_silo/hierarchical``,
+  docs/hierarchical.md) — rank N of the root fabric, server of its own
+  client fabric, streaming-folding its client partition and shipping
+  one merged limb-set upstream per round close.
 - ``trace``    — beyond the reference: stitch the per-process trace
   shards a run exported into ``telemetry_dir`` into ONE
   perfetto-loadable timeline (cross-process flow events matched,
@@ -262,6 +267,33 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_edge(args) -> int:
+    """Launch one edge aggregator rank of the hierarchical server plane
+    (``fedml_tpu/cross_silo/hierarchical`` — docs/hierarchical.md).
+
+    Reads the federation config (``--cf``), forces ``edge_plane:
+    ranks``, and runs an ``EdgeServerManager``: rank N of the root
+    fabric, server of its own client fabric, streaming-folding its
+    partition's uploads and shipping one merged limb-set per round.
+    ``--dry-run`` builds the model + partition, prints one status JSON
+    line, and exits (the ``serve --dry-run`` smoke seam)."""
+    from .arguments import Arguments
+    from .edge_agent import run_edge
+
+    ns = argparse.Namespace(
+        yaml_config_file=args.cf or "",
+        rank=int(args.rank),
+        role="edge_server",
+        run_id=args.run_id,
+    )
+    a = Arguments(ns)
+    a.edge_plane = "ranks"
+    if args.backend:
+        a.backend = args.backend
+    a._validate()
+    return run_edge(a, dry_run=args.dry_run)
+
+
 def cmd_trace(args) -> int:
     """Stitch a run's trace shards + analyze round critical paths.
 
@@ -369,6 +401,20 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--run-id", dest="run_id", default="0")
     serve.add_argument("--dry-run", action="store_true")
     serve.set_defaults(fn=cmd_serve)
+
+    edge = sub.add_parser("edge")
+    edge.add_argument("--cf", "--yaml_config_file", dest="cf", default="")
+    edge.add_argument(
+        "--rank", type=int, required=True,
+        help="this edge's rank on the root fabric (1..edge_num)",
+    )
+    edge.add_argument(
+        "--backend", default=None,
+        type=lambda s: s.upper(), choices=[None, "LOCAL", "GRPC"],
+    )
+    edge.add_argument("--run-id", dest="run_id", default="0")
+    edge.add_argument("--dry-run", action="store_true")
+    edge.set_defaults(fn=cmd_edge)
 
     trace = sub.add_parser("trace")
     trace.add_argument(

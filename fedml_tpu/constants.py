@@ -158,3 +158,28 @@ MSG_ARG_KEY_TRAIN_SECONDS = "train_seconds"
 # per-dispatch sequence id in async mode, which is what makes folds
 # exactly-once attributable across retransmits and server restarts.
 MSG_ARG_KEY_MODEL_VERSION = "model_version"
+
+# Hierarchical server plane (cross_silo/hierarchical edge ranks —
+# beyond the reference, whose "hierarchical" scenario is intra-silo
+# process groups): edges are real ranks over the comm seam. The root
+# reuses the S2C round downlinks (init/sync/resync) toward edges, with
+# the per-client silo assignment map and the root's quarantine decision
+# riding as extra params; the edge ships ONE merged limb-set (its
+# streaming accumulator's exact 3-limb expansion + weights + folded
+# set) upstream per round close, and forwards client death/leave/
+# anomaly evidence as CLIENT_EVENTs — the root decides, edges enforce.
+MSG_TYPE_E2R_EDGE_REPORT = 60
+MSG_TYPE_E2R_CLIENT_EVENT = 61
+MSG_ARG_KEY_EDGE_STATE = "edge_state"
+MSG_ARG_KEY_HIER_ASSIGNMENT = "hier_assignment"
+MSG_ARG_KEY_QUARANTINED = "quarantined"
+MSG_ARG_KEY_EVENT_KIND = "event_kind"
+MSG_ARG_KEY_COHORT = "cohort"
+MSG_ARG_KEY_FOLDED = "folded"
+
+# client-event kinds an edge reports upstream (root decides, edges
+# enforce — docs/hierarchical.md failure model)
+HIER_EVENT_DEAD = "dead"
+HIER_EVENT_LEAVE = "leave"
+HIER_EVENT_ONLINE = "online"
+HIER_EVENT_QUARANTINE = "quarantine_evidence"

@@ -484,6 +484,40 @@ class StreamingAccumulator:
             return None
         return _tree_scaled(self._limbs[0], jnp.float32(self.total_w))
 
+    def export_state(self) -> dict:
+        """Wire-portable snapshot of the fold state: the exact 3-limb
+        float32 expansion (as host numpy trees — msgpack-ready), the
+        folded weight total and the fold count. The hierarchical server
+        plane ships this edge→root once per round close; ``merge`` of a
+        ``load_state``-restored shell is bitwise identical to merging
+        the live accumulator, because the limbs ARE the state (no
+        rounding happens at export — numpy conversion is a byte-exact
+        device fetch)."""
+        return {
+            "limbs": [
+                jax.tree.map(lambda x: np.asarray(x), limb)  # lint: host-sync-ok — export IS the deliberate fetch
+                for limb in self._limbs
+            ],
+            "total_w": float(self.total_w),  # lint: host-sync-ok — python-float bookkeeping, not device values
+            "count": int(self.count),  # lint: host-sync-ok — python-int bookkeeping
+        }
+
+    def load_state(self, state: dict) -> "StreamingAccumulator":
+        """Restore an ``export_state`` snapshot onto this accumulator
+        (template must match the exporter's). Limbs stay as delivered —
+        the fold/merge jits device-put them unchanged, so a root-side
+        merge of an imported edge state is bitwise identical to merging
+        the edge's live accumulator."""
+        limbs = state["limbs"]
+        if len(limbs) != 3:
+            raise ValueError(
+                f"edge fold state carries {len(limbs)} limbs, expected 3"
+            )
+        self._limbs = tuple(limbs)
+        self.total_w = float(state["total_w"])  # lint: host-sync-ok — wire scalar
+        self.count = int(state["count"])  # lint: host-sync-ok — wire scalar
+        return self
+
     def merge(self, other: "StreamingAccumulator") -> None:
         """Fold another accumulator's state into this one — the edge ->
         root hop of a two-tier aggregation tree (``fedml_tpu/scale/

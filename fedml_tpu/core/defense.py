@@ -200,11 +200,11 @@ class AnomalyScreen:
         feeds the reference window, keeping the median comparable
         across staleness."""
         if running_ref is None:
-            norm, cos = float(global_norm(delta)), None
+            norm, cos = float(global_norm(delta)), None  # lint: host-sync-ok — the screen scores per upload on host by design
         else:
             n, c = _norm_and_cos(delta, running_ref)
-            norm, cos = float(n), float(c)
-        norm = norm / (1.0 + max(int(staleness), 0))
+            norm, cos = float(n), float(c)  # lint: host-sync-ok — the screen scores per upload on host by design
+        norm = norm / (1.0 + max(int(staleness), 0))  # lint: host-sync-ok — staleness is a wire int
         return anomaly_score(norm, cos, self._ref_norm), norm, cos
 
     def observe(self, index: int, score: float, norm: float) -> bool:

@@ -42,6 +42,15 @@ no_lost_unreported      telemetry.jsonl          folds accepted - published
 counters_cover_ledger   round_wal + telemetry    agg_folds_total >= ledger
 chaos_trace_consistent  trace.json + telemetry   chaos.fault instants ==
                                                  chaos_faults_injected_total
+edge_partition          round_wal.jsonl          per-edge fold sets are
+                                                 disjoint and union to the
+                                                 round's folded set
+edge_merge_exactly_once round_wal + telemetry    hier_edge_merges_total ==
+                                                 WAL (edge, round) entries
+                                                 (± crashes + failures)
+edge_subledger_         round_wal + edge_*/      every merged edge set has a
+  consistent            round_wal.jsonl          matching write-ahead record
+                                                 in that edge's sub-ledger
 ======================  =======================  =========================
 
 Counter-based invariants read the final snapshot per rank; in a LOCAL
@@ -166,6 +175,9 @@ class InvariantChecker:
         self.checkpoint_dir = checkpoint_dir or telemetry_dir
         self.wal_records: List[dict] = []
         self.wal_path: Optional[str] = None
+        # hierarchical server plane: per-edge WAL sub-ledgers live in
+        # {checkpoint_dir}/edge_{rank}/round_wal.jsonl
+        self.edge_ledgers: Dict[int, List[dict]] = {}
         self.counters: Dict[str, float] = {}
         self.counters_reset = False
         self.snapshots: List[dict] = []
@@ -181,6 +193,22 @@ class InvariantChecker:
             if os.path.exists(path):
                 self.wal_path = path
                 self.wal_records = RoundWAL(self.checkpoint_dir).records()
+            if os.path.isdir(self.checkpoint_dir):
+                for name in sorted(os.listdir(self.checkpoint_dir)):
+                    if not name.startswith("edge_"):
+                        continue
+                    sub = os.path.join(
+                        self.checkpoint_dir, name, RoundWAL.FILENAME
+                    )
+                    if not os.path.exists(sub):
+                        continue
+                    try:
+                        edge = int(name.split("_", 1)[1])
+                    except ValueError:
+                        continue
+                    self.edge_ledgers[edge] = RoundWAL(
+                        os.path.join(self.checkpoint_dir, name)
+                    ).records()
         if self.telemetry_dir:
             tpath = os.path.join(self.telemetry_dir, "telemetry.jsonl")
             if os.path.exists(tpath):
@@ -234,7 +262,137 @@ class InvariantChecker:
             self._check_async(rep, publishes)
         self._check_counters(rep, sync, publishes)
         self._check_chaos_trace(rep)
+        self._check_edge_tier(rep, sync)
         return rep
+
+    # -- multi-tier invariants (hierarchical server plane) ------------
+    def _check_edge_tier(self, rep, sync) -> None:
+        """The hierarchical plane's exactly-once story, from artifacts:
+        every round's per-edge fold sets must PARTITION the round's
+        folded set (an upload folds at exactly one edge and reaches the
+        root exactly once), the root's merge counter must balance the
+        WAL's (edge, round) entries, and each merged set must have its
+        write-ahead twin in that edge's sub-ledger."""
+        hier = [r for r in sync if r.get("edge_folds")]
+        if not hier:
+            for n in (
+                "edge_partition", "edge_merge_exactly_once",
+                "edge_subledger_consistent",
+            ):
+                rep.skip(n, "no hierarchical (edge_folds) records")
+            return
+        rep.note_checked("edge_partition")
+        wal_merges = 0
+        for i, rec in enumerate(hier):
+            folded = set(rec.get("folded") or [])
+            seen: set = set()
+            union: set = set()
+            for edge, ranks in sorted((rec.get("edge_folds") or {}).items()):
+                wal_merges += 1
+                rset = set(int(r) for r in ranks)
+                overlap = seen & rset
+                if overlap:
+                    rep.fail(
+                        "edge_partition",
+                        f"record {i} (round {rec['round_idx']}): rank(s) "
+                        f"{sorted(overlap)} folded at more than one edge — "
+                        "an upload was double-merged",
+                        edge=edge,
+                    )
+                seen |= rset
+                union |= rset
+            if union != folded:
+                rep.fail(
+                    "edge_partition",
+                    f"record {i} (round {rec['round_idx']}): the per-edge "
+                    f"fold sets union to {sorted(union)} but the round "
+                    f"folded {sorted(folded)} — the sub-ledgers do not "
+                    "partition the root's folded set",
+                )
+        # merge counter balance (same crash tolerances as the other
+        # counter-matched invariants: a kill between the merge and the
+        # round's WAL append strands up to one record's merges)
+        merges_ctr = self._ctr("hier_edge_merges_total")
+        if not self.counters or not merges_ctr:
+            rep.skip("edge_merge_exactly_once", "no merge counters in snapshot")
+        elif self.counters_reset:
+            rep.skip(
+                "edge_merge_exactly_once",
+                "counters reset by a restart; the final snapshot "
+                "under-counts the run",
+            )
+        else:
+            rep.note_checked("edge_merge_exactly_once")
+            kills = _counter_tagged(
+                self.counters, "chaos_faults_injected_total",
+                "fault", ("kill_server", "kill_client", "torn_write"),
+            )
+            failures = self._ctr("wal_append_failures_total")
+            max_edges = max(
+                (len(r.get("edge_folds") or {}) for r in hier), default=0
+            )
+            gap = merges_ctr - wal_merges
+            if gap < 0:
+                rep.fail(
+                    "edge_merge_exactly_once",
+                    f"the WAL holds {wal_merges} per-edge merge entries but "
+                    f"only {merges_ctr:g} merges were counted — a merged "
+                    "limb-set entered the ledger twice",
+                )
+            elif gap > (kills + failures) * max(max_edges, 1):
+                rep.fail(
+                    "edge_merge_exactly_once",
+                    f"{gap:g} counted merge(s) never reached the WAL — "
+                    f"beyond what {kills:g} crash(es) and {failures:g} "
+                    "append failure(s) can explain (a duplicate report "
+                    "was merged instead of dropped)",
+                )
+        # write-ahead sub-ledger twins (only checkable when the edge
+        # kept one — the sub-ledger dir rides checkpoint_dir)
+        if not self.edge_ledgers:
+            rep.skip(
+                "edge_subledger_consistent", "no edge_*/ sub-ledgers found"
+            )
+            return
+        by_edge_round: Dict[tuple, List[List[int]]] = {}
+        for edge, records in self.edge_ledgers.items():
+            for rec in records:
+                key = (int(edge), int(rec["round_idx"]))
+                by_edge_round.setdefault(key, []).append(
+                    sorted(int(r) for r in rec.get("folded") or [])
+                )
+        misses = []
+        for i, rec in enumerate(hier):
+            for edge_s, ranks in sorted((rec.get("edge_folds") or {}).items()):
+                edge = int(edge_s)
+                if edge not in self.edge_ledgers:
+                    continue  # that edge ran without a sub-ledger dir
+                attempts = by_edge_round.get((edge, int(rec["round_idx"])), [])
+                if sorted(int(r) for r in ranks) not in attempts:
+                    misses.append((i, rec, edge, ranks, attempts))
+        # a refused/failed sub-ledger append is a fault the edge
+        # deliberately survives (logged + counted, the report still
+        # ships) — counted append failures grant the same allowance
+        # the other counter-balanced invariants give
+        append_failures = self._ctr("wal_append_failures_total")
+        if misses and len(misses) <= append_failures:
+            rep.skip(
+                "edge_subledger_consistent",
+                f"{len(misses)} merged set(s) without a write-ahead twin "
+                f"are covered by {append_failures:g} counted WAL append "
+                "failure(s) (degraded durability, not a ledger bug)",
+            )
+            return
+        rep.note_checked("edge_subledger_consistent")
+        for i, rec, edge, ranks, attempts in misses:
+            rep.fail(
+                "edge_subledger_consistent",
+                f"record {i} (round {rec['round_idx']}): the root "
+                f"merged {sorted(ranks)} from edge {edge} but that "
+                "edge's sub-ledger has no matching write-ahead "
+                f"record (attempts: {attempts})",
+                edge=edge,
+            )
 
     # -- WAL-internal invariants --------------------------------------
     def _check_wal_shape(self, rep, sync, publishes) -> None:

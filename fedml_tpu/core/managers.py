@@ -78,6 +78,53 @@ def _build_com_manager(
     raise ValueError(f"unsupported comm backend {backend!r}")
 
 
+def _wrap_comm_stack(com: BaseCommunicationManager, args):
+    """THE wrap pyramid, one copy (``_ManagerBase`` and
+    ``build_comm_stack`` both route through it): telemetry/tracing
+    instrumentation innermost (wire-traffic semantics — a dropped
+    message never left, a duplicated one left twice), fault injection
+    above it, the ReliableChannel OUTERMOST so retransmits re-traverse
+    the injector. The chaos plane installs BEFORE wrapping so
+    ``maybe_wrap_faulty`` can pick up a scheduled send plan."""
+    from .chaos import maybe_install_chaos
+    from .comm.faults import maybe_wrap_faulty
+    from .comm.instrument import wrap_instrumented
+    from .comm.reliable import maybe_wrap_reliable
+
+    maybe_install_chaos(args)
+    return maybe_wrap_reliable(
+        maybe_wrap_faulty(wrap_instrumented(com, args), args), args
+    )
+
+
+def build_comm_stack(
+    args,
+    rank: int,
+    size: int,
+    backend: str,
+    run_id=None,
+    port_base=None,
+):
+    """Build a FULLY WRAPPED communication manager outside a manager
+    class — the hierarchical server plane's second hop (an edge process
+    is rank 0 of its client fabric AND a client-side rank of the root
+    fabric, so it needs two stacks). Wrapping is ``_wrap_comm_stack``
+    — identical to every manager's. ``run_id``/``port_base`` override
+    the fabric identity without mutating the caller's args (LOCAL
+    fabric name / gRPC port block per hop)."""
+    import copy
+
+    hop_args = copy.copy(args)
+    hop_args.rank = int(rank)
+    if run_id is not None:
+        hop_args.run_id = run_id
+    if port_base is not None:
+        hop_args.grpc_port_base = int(port_base)
+    return _wrap_comm_stack(
+        _build_com_manager(hop_args, rank, size, backend), hop_args
+    )
+
+
 def build_grpc_manager(
     rank: int,
     size: int,
@@ -132,34 +179,13 @@ class _ManagerBase(Observer):
         self.com_manager = comm if comm is not None else _build_com_manager(
             args, rank, size, backend
         )
-        from .chaos import maybe_install_chaos
-        from .comm.faults import maybe_wrap_faulty
-        from .comm.instrument import wrap_instrumented
-        from .comm.reliable import maybe_wrap_reliable
         from .telemetry import Telemetry
 
-        # deterministic chaos plane (core/chaos.py): installed BEFORE
-        # the comm stack is wrapped so maybe_wrap_faulty can pick up
-        # the schedule's send plan; also arms the durable-IO seam the
-        # WAL/checkpoint writes route through. No-op without the
-        # chaos_schedule / io_faults knobs.
-        maybe_install_chaos(args)
-
-        # telemetry counting sits INSIDE fault injection: the counters
-        # record actual wire traffic (a dropped message never left, a
-        # duplicated one left twice); injections themselves are counted
-        # by the FaultInjector (comm_faults_injected_total). The
-        # reliable channel sits OUTSIDE both: its retransmissions must
-        # re-traverse the fault injector (an injected drop is exactly
-        # the lossy link a retry recovers) and be counted as the wire
-        # traffic they are.
         self.telemetry = Telemetry.get_instance(args)
-        self.com_manager = maybe_wrap_reliable(
-            maybe_wrap_faulty(
-                wrap_instrumented(self.com_manager, args), args
-            ),
-            args,
-        )
+        # ONE wrap pyramid (see _wrap_comm_stack): chaos plane installed
+        # first, instrumentation innermost, fault injection above it,
+        # the reliable channel outermost
+        self.com_manager = _wrap_comm_stack(self.com_manager, args)
         self.com_manager.add_observer(self)
         self.message_handler_dict: Dict[int, Callable[[Message], None]] = {}
 

@@ -443,6 +443,13 @@ def analyze_rounds(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
       (includes server dispatch-queue wait);
     - ``server_decode``: upload receipt → aggregate B (payload decode);
     - ``aggregate``: the server's aggregate span;
+    - ``edge_merge`` / ``root_fold`` (hierarchical server plane only):
+      when a round carries edge-tier spans, the two-hop flow
+      client→edge→root is split out — ``edge_merge`` is the
+      last-closing edge's limb-set export span and ``root_fold`` the
+      sum of the root's per-edge merge spans; ``server_decode`` then
+      shrinks to the residual of the upload-receipt→aggregate window
+      (uplink wire + sibling-edge waits);
     - ``other``: wall − sum(above) — ≈0 when the chain is complete
       (the segments are consecutive walks of the same path); it grows
       exactly when a span is missing or the aggregate was triggered by
@@ -465,6 +472,8 @@ def analyze_rounds(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     recvs = {}                  # flow id -> first recv span
     trains = defaultdict(dict)  # round -> rank -> train span
     aggregates = {}             # round -> aggregate span
+    edge_merges = defaultdict(list)  # round -> edge_merge spans (hier)
+    root_folds = defaultdict(list)   # round -> root_fold spans (hier)
     for sp in spans:
         a = sp["args"] or {}
         if sp["name"] == "comm.send" and "round" in a:
@@ -480,6 +489,10 @@ def analyze_rounds(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             trains[int(a["round"])].setdefault(int(a["rank"]), sp)
         elif sp["name"] == "aggregate" and "round" in a:
             aggregates.setdefault(int(a["round"]), sp)
+        elif sp["name"] == "edge_merge" and "round" in a:
+            edge_merges[int(a["round"])].append(sp)
+        elif sp["name"] == "root_fold" and "round" in a:
+            root_folds[int(a["round"])].append(sp)
 
     reports = []
     for r in sorted(sends):
@@ -517,6 +530,22 @@ def analyze_rounds(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         if s_up_rx is not None:
             seg["upload_wire"] = s_up_rx["ts"] - s_up["ts"]
             seg["server_decode"] = agg["ts"] - s_up_rx["ts"]
+        ems, rfs = edge_merges.get(r), root_folds.get(r)
+        if ems and rfs and s_up_rx is not None:
+            # hierarchical two-hop split: the upload lands at an EDGE,
+            # whose close exports the limb-set (edge_merge) the root
+            # then merges (root_fold) before the finalize — name those
+            # pieces and leave the uplink wire / sibling-edge waits as
+            # the server_decode residual
+            last_em = max(ems, key=lambda s: s["ts"] + s["dur"])
+            seg["edge_merge"] = last_em["dur"]
+            seg["root_fold"] = sum(s["dur"] for s in rfs)
+            seg["server_decode"] = max(
+                (agg["ts"] - s_up_rx["ts"])
+                - seg["edge_merge"]
+                - seg["root_fold"],
+                0.0,
+            )
         seg["aggregate"] = agg["dur"]
         named = sum(seg.values())
         seg["other"] = wall - named

@@ -29,24 +29,50 @@ from __future__ import annotations
 
 from .client_master_manager import ClientMasterManager
 from .client_slave_manager import ClientSlaveManager
+from .edge_server_manager import EdgeServerManager
+from .federation import (
+    HierEdge,
+    HierRoot,
+    hier_partition,
+    prepare_client_args,
+    run_local_hier_world,
+)
 from .launcher import launch_silo_processes
+from .plane import (
+    edge_clients,
+    edge_fabric_run_id,
+    edge_port_base,
+    plan_edge_partition,
+)
 from .process_group_manager import (
     ProcessGroupManager,
     build_silo_fabric,
     ensure_distributed_initialized,
     silo_fabric_name,
 )
+from .root_server_manager import RootServerManager
 from .trainer_dist_adapter import TrainerDistAdapter
 
 __all__ = [
     "ClientMasterManager",
     "ClientSlaveManager",
+    "EdgeServerManager",
+    "HierEdge",
+    "HierRoot",
     "ProcessGroupManager",
+    "RootServerManager",
     "TrainerDistAdapter",
     "HierarchicalClient",
     "build_silo_fabric",
+    "edge_clients",
+    "edge_fabric_run_id",
+    "edge_port_base",
     "ensure_distributed_initialized",
+    "hier_partition",
     "launch_silo_processes",
+    "plan_edge_partition",
+    "prepare_client_args",
+    "run_local_hier_world",
     "silo_fabric_name",
 ]
 

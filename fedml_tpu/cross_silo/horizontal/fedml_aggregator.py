@@ -148,9 +148,18 @@ class FedMLAggregator:
         # edge tier slides under a live federation without changing a
         # result bit. Sync streaming only: async folds deltas against a
         # moving global and keeps the flat accumulator.
+        # with edge_plane=ranks the edges are REAL processes
+        # (cross_silo/hierarchical): each process runs a flat streaming
+        # accumulator and the ROOT does the tree merge — building the
+        # in-process tree here too would nest the tiers
         edge_num = int(getattr(args, "edge_num", 0) or 0)
         self._tree = None
-        if edge_num >= 2 and self.streaming and self.agg_mode == "stream":
+        if (
+            edge_num >= 2
+            and self.streaming
+            and self.agg_mode == "stream"
+            and str(getattr(args, "edge_plane", "inproc")) != "ranks"
+        ):
             from ...scale.tree import EdgeAggregationTree
 
             self._tree = EdgeAggregationTree(self.global_params, edge_num)
@@ -226,7 +235,7 @@ class FedMLAggregator:
             return "duplicate"
         payload = model_params if model_params is not None else encoded
         payload = reconcile_to_device(payload)
-        w = float(sample_num) * float(weight_scale)
+        w = float(sample_num) * float(weight_scale)  # lint: host-sync-ok — wire/knob scalars, never device values
         if self.screen.enabled and self._screen_upload(
             index, payload, raw=model_params is not None, delta_mode=False,
             w=w,
@@ -262,7 +271,7 @@ class FedMLAggregator:
             self.peak_buffered = max(self.peak_buffered, len(self._pending))
             self._tel.set_gauge("agg_peak_buffered", self.peak_buffered)
         self._folded.add(index)
-        self.sample_num_dict[index] = float(sample_num)
+        self.sample_num_dict[index] = float(sample_num)  # lint: host-sync-ok — wire scalar
         self.flag_client_model_uploaded_dict[index] = True
         return "folded" if self.streaming else "buffered"
 
@@ -416,7 +425,7 @@ class FedMLAggregator:
 
         payload = delta if delta is not None else encoded
         payload = reconcile_to_device(payload)
-        w = float(sample_num) * float(weight_scale)
+        w = float(sample_num) * float(weight_scale)  # lint: host-sync-ok — wire/knob scalars, never device values
         if (
             index is not None
             and self.screen.enabled
@@ -518,7 +527,7 @@ class FedMLAggregator:
         quorum instead of stalling the grace timer."""
         import math
 
-        return max(1, math.ceil(float(frac) * self.client_num))
+        return max(1, math.ceil(float(frac) * self.client_num))  # lint: host-sync-ok — knob scalar
 
     def quorum_met(self, frac: float) -> bool:
         return len(self._folded) >= self.quorum_target(frac)
@@ -528,7 +537,7 @@ class FedMLAggregator:
         With elastic membership the active set is not contiguous
         (clients join/leave mid-run), so completion is checked against
         THIS set instead of range(client_num)."""
-        self._expected = set(int(i) for i in expected_indexes)
+        self._expected = set(int(i) for i in expected_indexes)  # lint: host-sync-ok — host rank ints
         self.client_num = len(self._expected)
 
     def _reconstructed_pending(self) -> List[Tuple[int, Params, float]]:
@@ -612,6 +621,34 @@ class FedMLAggregator:
         self._agg_round += 1
         self._reset_window()
         return self.global_params
+
+    # -- hierarchical server plane (cross_silo/hierarchical) ----------
+    def export_fold_state(self) -> dict:
+        """The edge→root merge payload: this window's streaming fold
+        state (exact 3-limb expansion + weights + count) as a
+        wire-portable dict, WITHOUT finalizing — the root merges the
+        limbs through the same add-only exact jit, so the federation's
+        finalize stays bitwise identical to a flat fold of the same
+        uploads. Streaming mode only (the edge plane rejects buffered/
+        async at construction)."""
+        if not self.streaming or self._tree is not None:
+            raise RuntimeError(
+                "export_fold_state() needs the flat streaming accumulator "
+                "(agg_mode=stream, no in-process edge tree)"
+            )
+        if self._acc is None or self._acc.count == 0:
+            # an edge whose whole partition died/left ships an empty
+            # report; the root skips the merge and drops the cohort
+            return {"limbs": [], "total_w": 0.0, "count": 0}
+        return self._acc.export_state()
+
+    def reset_window(self) -> None:
+        """Public window reset for callers that close a round WITHOUT
+        finalizing here — the edge tier finalizes at the ROOT, so the
+        edge resets its own window after shipping ``export_fold_state``
+        upstream."""
+        self._agg_round += 1
+        self._reset_window()
 
     def _reset_window(self) -> None:
         """Clear per-round upload state (shared by ``aggregate`` and

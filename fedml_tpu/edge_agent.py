@@ -387,6 +387,61 @@ class EdgeAgent:
         self.client.close()
 
 
+def run_edge(args, dry_run: bool = False, output_dim: int = 10) -> int:
+    """Launch one EDGE AGGREGATOR rank of the hierarchical server plane
+    (``fedml-tpu edge --rank N --cf ...`` — docs/hierarchical.md).
+
+    ``args`` is a validated federation Arguments bag with
+    ``edge_plane=ranks``; ``args.rank`` is this edge's rank (1..E) on
+    the root fabric. Builds the model + client partition, constructs
+    the ``EdgeServerManager`` facade and blocks in its receive loops.
+    ``dry_run`` builds everything buildable without binding transports,
+    prints one status JSON line, and exits — the smoke seam
+    (``cli serve --dry-run`` pattern)."""
+    from . import models
+    from .cross_silo.hierarchical import (
+        HierEdge,
+        edge_clients,
+        edge_fabric_run_id,
+        hier_partition,
+    )
+    from .data import load
+
+    if str(getattr(args, "edge_plane", "inproc")) != "ranks":
+        raise ValueError(
+            "fedml-tpu edge launches the hierarchical server plane; set "
+            "edge_plane: ranks (and edge_num) in the config"
+        )
+    edge_rank = int(getattr(args, "rank", 0))
+    if edge_rank < 1 or edge_rank > int(args.edge_num):
+        raise ValueError(
+            f"--rank {edge_rank}: an edge rank is 1..edge_num "
+            f"(= {args.edge_num}); 0 is the root"
+        )
+    dataset = load(args)
+    model = models.create(
+        args, dataset.class_num if dataset is not None else int(output_dim)
+    )
+    partition = hier_partition(args, dataset)
+    mine = edge_clients(partition).get(edge_rank, [])
+    status = {
+        "edge_rank": edge_rank,
+        "edge_num": int(args.edge_num),
+        "clients": mine,
+        "fabric": edge_fabric_run_id(getattr(args, "run_id", "0"), edge_rank),
+        "backend": str(getattr(args, "backend", "LOCAL")),
+        "model": model.name,
+        "agg_mode": str(getattr(args, "agg_mode", "stream")),
+    }
+    if dry_run:
+        print(json.dumps(status))
+        return 0
+    logging.info("edge agent: starting edge rank %d (%s)", edge_rank, status)
+    edge = HierEdge(args, None, dataset, model, partition=partition)
+    edge.run()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="fedml_tpu.edge_agent")
     p.add_argument("--account-id", required=True)

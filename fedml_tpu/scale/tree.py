@@ -68,17 +68,17 @@ class EdgeAggregationTree:
         """index -> edge, near-equal total load per edge
         (``core/scheduler.balance_clients_across_shards``)."""
         shards = balance_clients_across_shards(list(client_sizes), edge_num)
-        return {int(i): e for e, lane in enumerate(shards) for i in lane}
+        return {int(i): e for e, lane in enumerate(shards) for i in lane}  # lint: host-sync-ok — host rank ints
 
     # -- routing ------------------------------------------------------
     def edge_of(self, index: int) -> int:
         if self._assignment is not None:
-            return int(self._assignment[int(index)])
-        return int(index) % self.edge_num
+            return int(self._assignment[int(index)])  # lint: host-sync-ok — host rank ints
+        return int(index) % self.edge_num  # lint: host-sync-ok — host rank int
 
     def acc(self, edge: int) -> StreamingAccumulator:
         """Edge ``edge``'s accumulator (term-level folds)."""
-        return self._edges[int(edge)]
+        return self._edges[int(edge)]  # lint: host-sync-ok — host rank int
 
     def acc_for(self, index: int) -> StreamingAccumulator:
         """The accumulator upload ``index`` folds into — exposes every

@@ -212,8 +212,14 @@ GROUPS = [
         "serve_deadline_ms", "serve_bucket", "serve_watch_interval_s",
     ]),
     ("Planet scale (registry-backed populations)", [
-        "client_registry_size", "cohort_size", "edge_num",
+        "client_registry_size", "cohort_size",
         "registry_dir", "edge_flat_fold",
+    ]),
+    # edge_num graduated from simulation-only: with edge_plane=ranks it
+    # sizes the REAL edge-aggregator tier (docs/hierarchical.md); with
+    # "inproc" it keeps the in-process tree (simulation + cross-silo)
+    ("Hierarchical server plane (edge aggregators as ranks)", [
+        "edge_num", "edge_plane", "hier_port_stride",
     ]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",

@@ -113,6 +113,7 @@ PHASES = [
     ("defense", ["--phase", "defense"], 420.0),
     ("chaosplan", ["--phase", "chaosplan"], 480.0),
     ("planet", ["--phase", "planet"], 480.0),
+    ("hier", ["--phase", "hier"], 480.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

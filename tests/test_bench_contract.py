@@ -162,6 +162,19 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"tracing"' in parent or "'tracing'" in parent
 
+    def test_hier_phase_contract(self):
+        """detail.hier ships the hierarchical-server-plane evidence
+        (uploads/s scaling vs edge count under a slow root link,
+        tree-over-ranks bit-identical to flat, edge kill/restart
+        recovery with the multi-tier invariant checker green): the
+        phase is in the child vocabulary and the parent stitches it
+        (like planet, it runs demoted on the CPU fallback)."""
+        assert "hier" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"hier"' in parent or "'hier'" in parent
+
 
 class TestPhaseChild:
     def _run_child(self, phase: str, timeout: int, smoke: bool = False) -> dict:
@@ -437,6 +450,38 @@ class TestPhaseChild:
         assert d["one_trace_per_shape"] is True
         assert d["trace_within_budget"] is True
         assert d["trace_count"] <= d["trace_budget"]
+
+    @pytest.mark.slow  # ~35s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's hier smoke block
+    def test_hier_smoke_child_writes_valid_json(self):
+        """The CI hier smoke invocation (3 clients/edge, edge_num ∈
+        {1,2,4}, 3 rounds, CPU): the hierarchical server plane runs
+        end-to-end through bench.py's hier phase child and emits the
+        detail.hier contract keys — uploads/s scaling ≥2x from 1 to 4
+        edges under the deliberately slow root link (the scheduled
+        per-merge delay is the fixed per-round cost the edges
+        amortize), tree-over-ranks bit-identical to the flat
+        single-server world, and the mid-round edge kill/restart
+        recovering bit-identically with the multi-tier invariant
+        checker green on every world's artifacts."""
+        d = self._run_child("hier", 500, smoke=True)
+        assert set(d["edges"]) == {"1", "2", "4"}
+        for e, entry in d["edges"].items():
+            assert entry["clients"] == d["per_edge_clients"] * int(e)
+            assert entry["uploads_folded"] == entry["clients"] * d["rounds"]
+            assert entry["merges"] == int(e) * d["rounds"]
+            assert entry["uploads_per_sec"] > 0
+            assert entry["check_ok"] is True
+        assert d["root_link_delay_s"] > 0
+        # the acceptance gate: E merged limb-sets amortize the slow
+        # root link over E x clients — ≥2x uploads/s at 4 edges vs 1
+        assert d["uploads_scaling_e4_vs_e1"] >= 2.0
+        assert d["hier_identical_to_flat"] is True
+        assert d["hier_vs_flat_max_abs_diff"] == 0.0
+        assert d["edge_kill_fired"] is True
+        assert d["edge_kill_max_abs_diff"] == 0.0
+        assert d["edge_kill_check_ok"] is True
+        assert d["invariants_ok_all"] is True
 
     @pytest.mark.slow  # ~90s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's tracing smoke block

@@ -708,7 +708,7 @@ class TestQuorumClose:
         def client_thread(c):
             try:
                 c.run()
-            except _Killed:
+            except _Killed:  # lint: except-ok — the scripted kill IS the test
                 pass
 
         threads = [
@@ -852,7 +852,7 @@ class TestKilledClientFailureDetector:
         def client_thread(c):
             try:
                 c.run()
-            except _Killed:
+            except _Killed:  # lint: except-ok — the scripted kill IS the test
                 pass
 
         threads = [
@@ -974,7 +974,7 @@ class TestServerRestartResync:
         def server1_thread():
             try:
                 server1.run()
-            except _Crash:
+            except _Crash:  # lint: except-ok — the scripted crash IS the test
                 pass
 
         st = threading.Thread(target=server1_thread, daemon=True)
