@@ -81,7 +81,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
     "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
-    "chaosplan", "planet", "hier",
+    "chaosplan", "planet", "hier", "multichip",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -2500,6 +2500,205 @@ def run_planet(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def _build_multichip_world(mesh_shape, cohort, rounds, n_clients):
+    """One fed-mesh world on the multichip mini-config (LR over the
+    MNIST-shaped synthetic stand-in; the mesh shape is the variable,
+    the model/data deliberately are not)."""
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data import load
+    from fedml_tpu.simulation import SimulatorMesh
+
+    args = Arguments()
+    for k, v in dict(
+        dataset="mnist",
+        synthetic_train_size=n_clients * 40,
+        synthetic_test_size=200,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=n_clients,
+        client_num_per_round=cohort,
+        comm_round=rounds,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.05,
+        frequency_of_the_test=10**9,
+        shuffle=False,
+        matmul_precision="default",
+        mesh_shape=mesh_shape,
+    ).items():
+        setattr(args, k, v)
+    args._validate()
+    args = fedml_tpu.init(args)  # flips threefry BEFORE the data loads
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    return SimulatorMesh(args, None, dataset, model)
+
+
+def run_multichip(on_cpu: bool, smoke: bool = False) -> dict:
+    """Mesh-sharded federation phase (parallel/layout.py +
+    fedavg_api's fed branch, docs/multichip.md) — the REAL multi-device
+    gate that replaces the MULTICHIP_r0x dryrun JSONs:
+
+    - rounds/s and clients/s per named (data, fsdp) mesh shape,
+      including the {data: 1, fsdp: 1} single-chip baseline;
+    - bitwise identity: every sharded shape's final params must equal
+      the single-chip vmap world's EXACTLY (``max_abs_diff == 0.0``) —
+      per-client compute is never tensor-split (FSDP gathers at use)
+      and the aggregation is the placement-independent exact expansion
+      fold;
+    - one jit trace per mesh shape (the compile census);
+    - on-mesh aggregation: the streaming fold stays bitwise
+      order-independent when uploads/limbs are (data, fsdp)-sharded
+      device trees, raw AND int8-encoded — stream ≡ buffered holds on
+      the mesh. Zero host transfers inside the round executables is a
+      compile-time fact (`fedml-tpu audit --ci` over
+      simulation.round_fn_mesh), not re-measured here.
+
+    Under ``--cpu`` the child forces 8 virtual host devices
+    (demoted-on-CPU like detail.planet); on a pod slice the same
+    choreography runs on real chips. ``smoke`` (CI gate): cohort 16,
+    3 rounds."""
+    import jax
+    import numpy as np
+
+    n = len(jax.devices())
+    cohort = 16 if smoke else 64
+    rounds = 3
+    n_clients = max(2 * cohort, 32)
+    out = {
+        "n_devices": n,
+        "cohort_size": cohort,
+        "rounds": rounds,
+        "device": str(jax.devices()[0]),
+    }
+    if n >= 8:
+        shapes = [
+            ("1x1", {"data": 1, "fsdp": 1}),
+            ("8x1", {"data": 8, "fsdp": 1}),
+            ("4x2", {"data": 4, "fsdp": 2}),
+            ("2x4", {"data": 2, "fsdp": 4}),
+        ]
+    elif n >= 2:
+        shapes = [
+            ("1x1", {"data": 1, "fsdp": 1}),
+            (f"{n}x1", {"data": n, "fsdp": 1}),
+        ]
+    else:
+        # a 1-chip TPU tunnel still exercises the fed path end to end;
+        # scaling evidence then needs a real slice — recorded, never
+        # silently skipped
+        shapes = [("1x1", {"data": 1, "fsdp": 1})]
+        out["single_device_only"] = True
+
+    base_params = None
+    entries = {}
+    last_sim = None
+    for key, shape in shapes:
+        _progress(f"multichip: world {key} ({shape})")
+        sim = _build_multichip_world(shape, cohort, rounds, n_clients)
+        sim.run()  # warm: every executable compiles once
+        t0 = time.perf_counter()
+        sim.run()  # timed: pure steady-state rounds
+        dt = time.perf_counter() - t0
+        api = sim.fl_trainer
+        entry = {
+            "mesh_shape": shape,
+            "rounds_per_sec": round(rounds / dt, 4),
+            "clients_per_sec": round(rounds * cohort / dt, 1),
+            "trace_count": api._round_trace_count,
+        }
+        params = jax.tree.map(np.asarray, api.global_params)
+        if base_params is None:
+            base_params = params
+        else:
+            diff = max(
+                float(abs(a - b).max())
+                for a, b in zip(
+                    jax.tree.leaves(base_params), jax.tree.leaves(params)
+                )
+            )
+            entry["max_abs_diff_vs_single_chip"] = diff
+            entry["identical_to_single_chip"] = diff == 0.0
+        entries[key] = entry
+        last_sim = sim
+        _progress(
+            f"multichip: {key} {entry['rounds_per_sec']} rounds/s, "
+            f"diff {entry.get('max_abs_diff_vs_single_chip', 'base')}"
+        )
+    out["shapes"] = entries
+    out["one_trace_per_shape"] = all(
+        e["trace_count"] == 1 for e in entries.values()
+    )
+    out["mesh_identical_to_single_chip"] = all(
+        e.get("identical_to_single_chip", True) for e in entries.values()
+    )
+
+    # on-mesh streaming aggregation: raw + int8 uplink folds in two
+    # arrival orders over (data, fsdp)-sharded device trees — the
+    # stream ≡ buffered bitwise contract, proven ON the mesh
+    from fedml_tpu.core.aggregation import StreamingAccumulator
+    from fedml_tpu.core.compression import Int8Codec
+    from fedml_tpu.parallel.layout import shard_tree
+
+    mesh = last_sim.mesh
+    rng = np.random.RandomState(5)
+    host = jax.tree.map(np.asarray, last_sim.fl_trainer.global_params)
+    uploads = [
+        shard_tree(
+            jax.tree.map(
+                lambda x: x + np.asarray(
+                    rng.standard_normal(x.shape), x.dtype
+                ) * 0.01,
+                host,
+            ),
+            mesh,
+        )
+        for _ in range(4)
+    ]
+    ws = [float(w) for w in rng.randint(1, 9, size=4)]
+
+    def fold_diff(fold_one):
+        a1 = StreamingAccumulator(uploads[0])
+        a2 = StreamingAccumulator(uploads[0])
+        for i in (0, 1, 2, 3):
+            fold_one(a1, i)
+        for i in (2, 0, 3, 1):
+            fold_one(a2, i)
+        return max(
+            float(abs(np.asarray(x) - np.asarray(y)).max())
+            for x, y in zip(
+                jax.tree.leaves(a1.finalize()), jax.tree.leaves(a2.finalize())
+            )
+        )
+
+    out["max_abs_diff_stream_raw"] = fold_diff(
+        lambda acc, i: acc.fold(uploads[i], ws[i])
+    )
+    codec = Int8Codec()
+    encs = [
+        codec.encode(jax.tree.map(lambda x: x * 0.01, u)) for u in uploads
+    ]
+    out["max_abs_diff_stream_int8"] = fold_diff(
+        lambda acc, i: acc.fold_encoded(codec, encs[i], uploads[0], ws[i])
+    )
+    out["agg_stream_raw_identical"] = out["max_abs_diff_stream_raw"] == 0.0
+    out["agg_stream_int8_identical"] = out["max_abs_diff_stream_int8"] == 0.0
+    # the host-transfer-freedom half of the acceptance: proven AOT by
+    # the audit gate over these registrations (ci/CI-script-smoke.sh)
+    out["mesh_executables_registered"] = [
+        "simulation.round_fn_mesh", "planet.group_fn",
+    ]
+    _progress(
+        f"multichip: stream raw diff {out['max_abs_diff_stream_raw']}, "
+        f"int8 diff {out['max_abs_diff_stream_int8']}"
+    )
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_hier(on_cpu: bool, smoke: bool = False) -> dict:
     """Hierarchical server plane phase (docs/hierarchical.md): edge
     aggregators as REAL ranks over the comm seam.
@@ -3165,6 +3364,10 @@ _PLANET_TIMEOUT_S = 420.0
 # — mini LR cohorts; the slow link adds rounds x 1s per scaling world
 # on top of cold-box jit compiles
 _HIER_TIMEOUT_S = 480.0
+# four (data, fsdp) mesh worlds on 8 virtual devices (LR mini
+# cohorts; each world pays one sharded-compile + collective-emulation
+# round set) + the on-mesh fold identity section
+_MULTICHIP_TIMEOUT_S = 420.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -3469,6 +3672,12 @@ def _main_guarded() -> None:
     # world, and a mid-round edge kill/restart recovering with the
     # multi-tier invariant checker green
     _run_demoted_phase("hier", _HIER_TIMEOUT_S)
+    # mesh-sharded federation phase (the (data, fsdp) production mesh):
+    # rounds/s + clients/s per mesh shape, every sharded shape bitwise
+    # identical to the single-chip vmap world, stream == buffered
+    # preserved on-mesh for raw and int8 uplinks — replaces the
+    # MULTICHIP_r0x dryrun JSONs with a measured gate
+    _run_demoted_phase("multichip", _MULTICHIP_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -3590,8 +3799,11 @@ def _phase_main(argv) -> None:
     if a.cpu:
         # the mesh phase needs devices to shard over — 2 virtual CPU
         # devices (more drowns the 1-core box in collective emulation);
-        # other phases run 1
-        _force_cpu(2 if a.phase == "mesh" else 1)
+        # multichip forces the full 8-device (data, fsdp) world (the
+        # LR model keeps collective emulation cheap); other phases 1
+        _force_cpu(
+            8 if a.phase == "multichip" else (2 if a.phase == "mesh" else 1)
+        )
     if a.phase == "headline":
         out = run_headline(on_cpu=a.cpu)
     elif a.phase == "bf16":
@@ -3622,6 +3834,8 @@ def _phase_main(argv) -> None:
         out = run_planet(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "hier":
         out = run_hier(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "multichip":
+        out = run_multichip(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -128,6 +128,19 @@ python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_planet_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
 
+# Multichip smoke (8 forced host devices, cohort 16 x 3 rounds, CPU):
+# the mesh-sharded federation must run end-to-end through bench.py's
+# multichip phase child and emit the detail.multichip contract keys —
+# rounds/s per (data, fsdp) mesh shape with EVERY sharded shape's
+# final params bitwise identical to the single-chip vmap world
+# (max_abs_diff == 0.0), one jit trace per shape, and the on-mesh
+# streaming fold bitwise order-independent for raw and int8 uplinks.
+# Host-transfer freedom of the mesh executables is the audit gate's
+# half (fedml-tpu audit --ci above, simulation.round_fn_mesh).
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_multichip_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider
+
 # Hierarchical server plane smoke (3 clients/edge, edge_num 1/2/4,
 # 3 rounds, CPU): edge aggregators as real ranks must run end-to-end
 # through bench.py's hier phase child and emit the detail.hier

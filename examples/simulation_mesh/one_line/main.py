@@ -9,6 +9,14 @@ laptop:
       python main.py --cf fedml_config.yaml
 
 client_num_per_round must tile the mesh's 'clients' axis (here 8).
+
+For the production (data, fsdp) mesh — params sharded at rest, the
+round bitwise identical at any mesh shape (docs/multichip.md) — set
+
+  train_args:
+    mesh_shape: {data: 4, fsdp: 2}
+
+and client_num_per_round must tile the 'data' axis instead.
 """
 
 import fedml_tpu

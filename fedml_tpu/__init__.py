@@ -63,6 +63,21 @@ def init(args: Optional[Arguments] = None) -> Arguments:
         "jax_default_matmul_precision",
         getattr(args, "matmul_precision", "highest"),
     )
+    from .parallel.layout import fed_mesh_shape
+
+    if fed_mesh_shape(getattr(args, "mesh_shape", None)) and not (
+        jax.config.jax_threefry_partitionable
+    ):
+        # fed (data, fsdp) mesh runs need SHARDING-INVARIANT random
+        # draws (the partitionable threefry) for the mesh-vs-single-
+        # chip bitwise identity; flipped here — before any data
+        # synthesis — so every world this process builds draws from
+        # the same stream (parallel/layout.py explains the hazard)
+        logging.info(
+            "mesh_shape=%s: enabling jax_threefry_partitionable "
+            "(sharding-invariant random draws)", args.mesh_shape,
+        )
+        jax.config.update("jax_threefry_partitionable", True)
     logging.getLogger().setLevel(
         logging.DEBUG if getattr(args, "verbose", False) else logging.INFO
     )

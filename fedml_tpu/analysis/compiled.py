@@ -314,7 +314,14 @@ _HOST_TRANSFER_TARGET = re.compile(
 )
 _CUSTOM_CALL = re.compile(r"custom_call\s*@([\w.]+)")
 _INFEED_OP = re.compile(r"\b(?:stablehlo|mhlo)\.(infeed|outfeed)\b")
-_ALIASING = re.compile(r"tf\.aliasing_output")
+# input-output aliasing in lowered modules takes two forms: a
+# single-device lowering resolves donation eagerly into per-arg
+# `tf.aliasing_output = N` attributes, while a multi-device SPMD
+# lowering (the mesh round engine) marks each donated leaf
+# `jax.buffer_donor = true` and lets XLA bind the aliases once the
+# output layouts are fixed. Both prove the donation contract is
+# present in the artifact; they never co-occur on one argument.
+_ALIASING = re.compile(r"tf\.aliasing_output|jax\.buffer_donor = true")
 _CONST_LINE = re.compile(
     r"(?:stablehlo|mhlo)\.constant\s+dense<(.)"
 )

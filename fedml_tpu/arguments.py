@@ -264,7 +264,9 @@ _DEFAULTS: Dict[str, Any] = {
     "pipeline_bucket": "pow2",
     # mesh axes -> sizes. Scenario-specific vocabulary: the distributed
     # platform (distributed.py) takes {dp/tp/ep} | {sp} | {pp}; the
-    # MESH simulation backend (simulation/simulator.py) takes
+    # MESH simulation backend (simulation/simulator.py) takes the fed
+    # production vocabulary {data, fsdp} (cohort over data, params
+    # sharded at rest over fsdp — docs/multichip.md) or the legacy
     # {clients, data}. None = scenario default (all devices, one axis)
     "mesh_shape": None,
     # capture an XLA device trace (tensorboard/perfetto) for the run
@@ -355,6 +357,13 @@ _DEFAULTS: Dict[str, Any] = {
     # local copy exists (reference data/MNIST/data_loader.py:17-29
     # behavior; off by default so offline runs never stall on egress)
     "download": False,
+    # persistent XLA compilation cache (core/compile_cache.py): root
+    # the content-addressed jit cache here so a warm re-launch (10k
+    # cohort world, mesh sweep, serving restart) skips every compile
+    # whose (HLO, flags, platform) key it has seen — hits/misses are
+    # counted in compile_cache_hits_total/_misses_total. One directory
+    # per process (process-global jax.config). None disables
+    "compile_cache_dir": None,
     # crash recovery / serving feed (core/checkpoint.py): directory for
     # orbax round checkpoints + the round WAL. None disables both —
     # a crashed server then restarts the federation from round 0
@@ -759,6 +768,14 @@ class Arguments:
         if self.max_clients < 1:
             raise ValueError(
                 f"max_clients={self.max_clients}: must be >= 1"
+            )
+        raw = getattr(self, "compile_cache_dir", None)
+        if raw is not None and not isinstance(raw, (str, os.PathLike)):
+            # the null-naming rule: a YAML `compile_cache_dir: 3` must
+            # name the knob, never surface inside jax.config
+            raise ValueError(
+                f"compile_cache_dir={raw!r}: must be a directory path "
+                "(or null to disable the persistent compilation cache)"
             )
         raw = getattr(self, "checkpoint_freq")
         if raw is not None:  # None = the scenario's historical cadence

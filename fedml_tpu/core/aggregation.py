@@ -229,6 +229,46 @@ def _fold_tree(limbs, term: Params):
     )
 
 
+def exact_weighted_mean(stacked: Params, weights: jax.Array) -> Params:
+    """Placement-independent weighted mean over a stacked client axis
+    — the mesh round engine's aggregation (``parallel/layout.py``).
+
+    ``weighted_average`` leaves the cross-client reduction order to
+    XLA, so sharding the client axis turns it into partial sums + a
+    psum whose bits differ from the single-chip reduction. This
+    version pins the bits instead, with the SAME error-free
+    transformation the streaming fold uses:
+
+    1. per-client terms ``t_c = fl32(w_c * theta_c)`` — elementwise,
+       so their bits are identical under any sharding;
+    2. a ``lax.scan`` folds the terms in client-index order into a
+       3-limb float32 expansion (Knuth two-sums, adds only — nothing
+       for XLA to contract into an FMA across clients);
+    3. the limbs collapse elementwise (``s0 + s1 + s2``).
+
+    Every step is either elementwise or a fixed-order sequential fold,
+    so a (data, fsdp)-sharded cohort finalizes to EXACTLY the bits of
+    the unsharded vmap run — the ``detail.multichip`` bench's
+    ``max_abs_diff == 0.0`` gate. Runs inside the donated round jit.
+    """
+    w32 = weights.astype(jnp.float32)
+
+    def leaf_mean(leaf: jax.Array) -> jax.Array:
+        wl = w32.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        terms = wl * leaf.astype(jnp.float32)  # [C, ...], rounded once
+
+        def step(limbs, t):
+            # THE limb fold — same ops, same order as the streaming
+            # accumulator's executable, never a re-implementation
+            return _fold_leaf(*limbs, t), None
+
+        z = jnp.zeros(leaf.shape[1:], jnp.float32)
+        (s0, s1, s2), _ = jax.lax.scan(step, (z, z, z), terms)
+        return (s0 + s1 + s2).astype(leaf.dtype)
+
+    return jax.tree.map(leaf_mean, stacked)
+
+
 @auditable("agg.weighted_term", _audit_term_inputs)
 @jax.jit
 def _weighted_term(theta: Params, w: jax.Array) -> Params:
@@ -518,21 +558,42 @@ class StreamingAccumulator:
         self.count = int(state["count"])  # lint: host-sync-ok — wire scalar
         return self
 
+    def fold_limbs(self, limbs, w: float, count: int = 1) -> None:
+        """Fold an exported 3-limb expansion carrying total weight
+        ``w`` over ``count`` underlying uploads — the device-resident
+        limb-set handoff (an on-mesh partial fold, or ``merge``'s edge
+        -> root hop, which routes through here so the ordering-
+        critical fold loop exists ONCE). Each limb is folded as a term
+        through the SAME add-only exact jit, so feeding limb-sets is
+        bitwise identical to having folded the underlying terms here.
+        ``w``/``count`` add exactly (the per-upload f32 rounding
+        already happened when each term folded at its source);
+        quorum/fold accounting reads ``count``, so it must reflect
+        uploads, not handoffs. The limbs may be (data, fsdp)-sharded
+        device trees; nothing is fetched to host."""
+        if len(limbs) != 3:
+            raise ValueError(f"expected a 3-limb expansion, got {len(limbs)}")
+        if count < 0:
+            raise ValueError(
+                f"count={count}: a limb-set represents >= 0 uploads"
+            )
+        for limb in limbs:
+            self._limbs = _fold_tree(self._limbs, limb)
+        self.total_w += float(w)  # lint: host-sync-ok — host scalar bookkeeping
+        self.count += int(count)  # lint: host-sync-ok — host int bookkeeping
+
     def merge(self, other: "StreamingAccumulator") -> None:
         """Fold another accumulator's state into this one — the edge ->
         root hop of a two-tier aggregation tree (``fedml_tpu/scale/
-        tree.py``). Each of the other's three limbs is folded as a term
-        through the SAME add-only exact-expansion jit, so the merged
-        expansion represents the union's sum to the usual ~2^-48 lowest-
-        limb error and the float32 finalize stays bitwise independent
-        of how uploads were partitioned across accumulators (tree ==
-        flat, asserted in tests and the ``detail.planet`` bench).
-        ``total_w``/``count`` add exactly (python floats over integer
-        sample counts)."""
-        for limb in other._limbs:
-            self._limbs = _fold_tree(self._limbs, limb)
-        self.total_w += other.total_w
-        self.count += other.count
+        tree.py``). Routes through :meth:`fold_limbs` (one copy of the
+        exact-expansion fold loop): the merged expansion represents
+        the union's sum to the usual ~2^-48 lowest-limb error and the
+        float32 finalize stays bitwise independent of how uploads were
+        partitioned across accumulators (tree == flat, asserted in
+        tests and the ``detail.planet`` bench). ``total_w``/``count``
+        add exactly (python floats over integer sample counts); an
+        empty other (count 0) is a no-op fold of zero limbs."""
+        self.fold_limbs(other._limbs, other.total_w, count=other.count)
 
     def _fold_term(self, term: Params, w: float) -> None:
         self._limbs = _fold_tree(self._limbs, term)

@@ -1,0 +1,143 @@
+"""Persistent XLA compilation cache behind ``args.compile_cache_dir``.
+
+A 10k-cohort planet world or a multi-shape mesh sweep spends its
+startup in XLA compiles (ROADMAP item 5's AOT-cache rider: the pow2
+census is exactly the set of executables worth caching). JAX already
+ships a content-addressed persistent cache; this module is the
+validated knob + telemetry seam in front of it:
+
+- ``maybe_enable_compile_cache(args)`` — idempotent, process-wide.
+  Points ``jax_compilation_cache_dir`` at the knob's directory and
+  drops the min-compile-time/min-entry-size floors to zero so the
+  small per-bucket round executables (milliseconds to compile on CPU,
+  the census that matters on TPU) are cached too. Called from every
+  engine init (``fedavg_api``, the planet loop, the serving engine);
+  the first caller wins, later calls with the same directory are
+  no-ops, a DIFFERENT directory mid-process logs a warning and keeps
+  the first (the cache knob is process-scoped state, like the chaos
+  schedule).
+- hit/miss telemetry: a ``jax.monitoring`` listener counts
+  ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` into
+  ``compile_cache_hits_total`` / ``compile_cache_misses_total``, and
+  ``cache_entries()`` gauges the directory (``compile_cache_entries``)
+  — a warm-started world shows hits == its executable census and a
+  cold one shows the same number as misses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_EVENT_HITS = "/jax/compilation_cache/cache_hits"
+_EVENT_MISSES = "/jax/compilation_cache/cache_misses"
+
+# process-scoped: the directory the cache was enabled with (None =
+# never enabled). jax.config is process-global, so this module is too.
+_enabled_dir: Optional[str] = None
+_listener_installed = False
+_warned_conflict = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    """jax.monitoring listener: fold cache hit/miss events into the
+    telemetry registry (host-side counter bumps only)."""
+    if event not in (_EVENT_HITS, _EVENT_MISSES):
+        return
+    from .telemetry import Telemetry
+
+    tel = Telemetry.get_instance()
+    if not tel.enabled:
+        return
+    if event == _EVENT_HITS:
+        tel.inc("compile_cache_hits_total")
+    else:
+        tel.inc("compile_cache_misses_total")
+        # a miss just wrote an entry — keep the directory gauge live
+        # (one listdir per compile, which already cost far more)
+        tel.set_gauge("compile_cache_entries", cache_entries())
+
+
+def cache_entries(directory: Optional[str] = None) -> int:
+    """Number of cache files currently in the (given or enabled)
+    cache directory; 0 when disabled/absent."""
+    d = directory or _enabled_dir
+    if not d or not os.path.isdir(d):
+        return 0
+    return sum(1 for n in os.listdir(d) if not n.startswith("."))
+
+
+def enabled_dir() -> Optional[str]:
+    return _enabled_dir
+
+
+def maybe_enable_compile_cache(args) -> bool:
+    """Enable the persistent compilation cache when
+    ``args.compile_cache_dir`` is set. Returns True when the cache is
+    active (now or from an earlier identical call)."""
+    global _enabled_dir, _listener_installed, _warned_conflict
+    d = getattr(args, "compile_cache_dir", None)
+    if not d:
+        return _enabled_dir is not None
+    d = os.path.abspath(str(d))
+    if _enabled_dir is not None:
+        if _enabled_dir != d and not _warned_conflict:
+            _warned_conflict = True
+            logging.warning(
+                "compile_cache_dir=%s ignored: the process-wide XLA "
+                "compilation cache is already rooted at %s (jax.config "
+                "is process-global; one directory per process)",
+                d, _enabled_dir,
+            )
+        return True
+    os.makedirs(d, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    for knob, val in (
+        # cache EVERYTHING: the round/fold/serving executables compile
+        # in milliseconds on CPU but in minutes on a TPU pod — the
+        # default 1s floor would skip exactly the census we warm-start
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # pragma: no cover - older jaxlib knob drift
+            logging.debug("compile cache: no config %s on this jax", knob)
+    try:
+        # jax latches its cache singleton DISABLED at the first compile
+        # of the process when no directory was configured yet — and the
+        # data loader's synthesis jits run before any engine init. Drop
+        # the latch so the next compile re-initializes against the
+        # directory just configured.
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:  # pragma: no cover - private-API drift
+        logging.warning(
+            "compile cache: could not reset jax's cache latch; if any "
+            "computation compiled before this call, the persistent "
+            "cache may stay disabled for this process"
+        )
+    if not _listener_installed:
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_on_event)
+            _listener_installed = True
+        except Exception:  # pragma: no cover - monitoring API drift
+            logging.warning(
+                "compile cache enabled but jax.monitoring is "
+                "unavailable — hit/miss counters will stay at zero "
+                "(cache_entries() still gauges the directory)"
+            )
+    _enabled_dir = d
+    from .telemetry import Telemetry
+
+    tel = Telemetry.get_instance()
+    if tel.enabled:
+        tel.set_gauge("compile_cache_entries", cache_entries(d))
+    logging.info("persistent compilation cache enabled at %s", d)
+    return True

@@ -146,9 +146,12 @@ class RoundPipeline:
         api = self.api
         args = api.args
         n_per_round = int(args.client_num_per_round)
-        shard_multiple = (
-            api.mesh.shape.get("clients", 1) if api.mesh is not None else 1
-        )
+        # compile buckets must tile the mesh's cohort axis ('clients'
+        # legacy / 'data' on the fed (data, fsdp) mesh) so every padded
+        # cohort shards evenly across the lanes
+        from ..parallel.layout import cohort_axis_size
+
+        shard_multiple = cohort_axis_size(api.mesh)
         bucket = bucket_cohort(
             n_per_round,
             self.bucket_policy,

@@ -1,13 +1,22 @@
 """Parallelism layer: mesh construction, sharded FL, in-silo SPMD.
 
 Axes vocabulary (compose freely on one Mesh):
-  clients/data — FL process-parallelism / in-client DP (mesh.py)
+  data/fsdp    — the fed production mesh: cohort lanes x at-rest
+                 parameter shards (layout.py, docs/multichip.md)
+  clients/data — legacy FL process-parallelism / in-client DP (mesh.py)
   sp           — sequence/context parallelism: ring + Ulysses (sequence.py)
   tp           — Megatron-style tensor parallelism (tensor.py)
   pp           — GPipe pipeline schedule under shard_map (pipeline.py)
   ep           — expert parallelism for MoE stacks (expert.py)
 """
 
+from .layout import (  # noqa: F401
+    SpecLayout,
+    build_fed_mesh,
+    is_fed_mesh,
+    shard_tree,
+    tree_specs,
+)
 from .mesh import build_mesh, shard_federation, replicate  # noqa: F401
 from .tensor import shard_params_tp, tp_specs  # noqa: F401
 from .expert import (  # noqa: F401

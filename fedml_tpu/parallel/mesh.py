@@ -48,10 +48,26 @@ def build_mesh(
 
 
 def federation_spec(mesh: Mesh) -> P:
-    """PartitionSpec for packed-federation leaves [C, nb, bs, ...]:
-    client axis over 'clients', per-batch example axis over 'data'."""
+    """PartitionSpec for packed-federation leaves [C, nb, bs, ...].
+
+    Legacy simulator mesh: client axis over 'clients', per-batch
+    example axis over 'data'. Fed (data, fsdp) mesh
+    (``parallel/layout.py``): client axis over 'data' only — a
+    client's own batches never split, so per-client compute stays
+    bitwise identical to the single-chip run."""
+    from .layout import is_fed_mesh
+
+    if is_fed_mesh(mesh):
+        return P("data")
     has_data = "data" in mesh.axis_names
     return P("clients", None, "data") if has_data else P("clients")
+
+
+def _cohort_axis_name(mesh: Mesh) -> str:
+    """The mesh axis the cohort/client dimension shards over."""
+    from .layout import is_fed_mesh
+
+    return "data" if is_fed_mesh(mesh) else "clients"
 
 
 def pad_federation(
@@ -119,7 +135,9 @@ def shard_federation(
     import jax.numpy as jnp
 
     ns = _put(
-        jnp.asarray(num_samples), NamedSharding(mesh, P("clients")), multi
+        jnp.asarray(num_samples),
+        NamedSharding(mesh, P(_cohort_axis_name(mesh))),
+        multi,
     )
     return Batches(x=f(packed.x), y=f(packed.y), mask=f(packed.mask)), ns
 
@@ -132,7 +150,10 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
 
 
 def pad_cohort_to_mesh(cohort_size: int, mesh: Mesh) -> int:
-    """Cohort size must tile the 'clients' axis; callers pad sampling
-    up to the next multiple (weights of repeats are zeroed)."""
-    n = mesh.shape["clients"]
+    """Cohort size must tile the cohort axis ('clients' legacy /
+    'data' fed); callers pad sampling up to the next multiple (weights
+    of repeats are zeroed)."""
+    from .layout import cohort_axis_size
+
+    n = cohort_axis_size(mesh)
     return -(-cohort_size // n) * n

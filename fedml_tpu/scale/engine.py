@@ -47,6 +47,7 @@ def build_group_fn(
     *,
     edge_num: int = 0,
     use_round_lr: bool = False,
+    mesh=None,
     on_trace=None,
 ):
     """The per-(bucket, nb) group computation, as a pure function of
@@ -60,10 +61,25 @@ def build_group_fn(
     computation across the (bucket, nb) census without a registry or
     data. ``on_trace`` fires at trace time only. Returns the UNjitted
     function; callers own the ``jax.jit``.
+
+    Donation contract (audited): ``global_params`` is returned as the
+    FIRST output, unchanged — callers jit with ``donate_argnums=(0,)``
+    and rebind their carry to that output per group
+    (``gp, terms, ... = group_fn(gp, ...)``), so XLA aliases the
+    buffer instead of copying the whole model into every group call
+    (the old zero-aliasing TODO in audit_baseline.json).
+
+    ``mesh`` (a fed ``(data, fsdp)`` mesh, ``parallel/layout.py``)
+    shards the group's client axis along ``data`` and gathers the
+    fsdp-sharded-at-rest params replicated for per-client compute —
+    every chip trains a slice of every (bucket, nb) group.
     """
     import jax
     import jax.numpy as jnp
 
+    from ..parallel.layout import is_fed_mesh
+
+    fed = mesh is not None and is_fed_mesh(mesh)
     E = max(1, edge_num)
 
     def group_fn(global_params, batches, ns, valid, edge_onehot, rng,
@@ -75,15 +91,31 @@ def build_group_fn(
         masked = batches.replace(
             mask=batches.mask * vm.astype(batches.mask.dtype)
         )
+        train_params = global_params
+        if fed:
+            from ..parallel.layout import fed_compute_constraints
+
+            # the shared fed entry discipline (cohort along 'data',
+            # params + routing scalars gathered replicated)
+            train_params, masked, ns, valid, edge_onehot = (
+                fed_compute_constraints(
+                    mesh, global_params, masked, ns, valid, edge_onehot
+                )
+            )
         rngs = jax.random.split(rng, C)
         if use_round_lr:
             stacked, metrics = jax.vmap(
                 local_train, in_axes=(None, 0, 0, None)
-            )(global_params, masked, rngs, lr_mult)
+            )(train_params, masked, rngs, lr_mult)
         else:
             stacked, metrics = jax.vmap(
                 local_train, in_axes=(None, 0, 0)
-            )(global_params, masked, rngs)
+            )(train_params, masked, rngs)
+        if fed:
+            from ..parallel.layout import pin_cohort_outputs
+
+            # per-client compute stays whole (see pin_cohort_outputs)
+            stacked = pin_cohort_outputs(mesh, stacked)
         w = ns * valid  # [C]; padded slots weigh zero
 
         def edge_sums(leaf):
@@ -97,18 +129,18 @@ def build_group_fn(
         terms = jax.tree.map(edge_sums, stacked)
         edge_w = jnp.einsum("c,ce->e", w, edge_onehot)
         summed = {k: v.sum() for k, v in metrics.items()}
-        return terms, edge_w, summed
+        return global_params, terms, edge_w, summed
 
     return group_fn
 
 
 @auditable(
     "planet.group_fn",
-    # round-shaped with NO donation claim: global_params is reused by
-    # every group of the same round, so the carried state cannot be
-    # donated here — the auditor's zero-aliasing finding for this
-    # executable rides audit_baseline.json as the documented TODO
-    # (ROADMAP item 5 / item 1's mesh refactor owns the fix)
+    # global_params rides through as output 0 and every call site
+    # rebinds its carry to it (gp, ... = group_fn(gp, ...)), so the
+    # donation aliases the whole model tree — the audit_baseline.json
+    # zero-aliasing TODO this executable used to carry is burned down
+    donate=(0,),
     round_shaped=True,
     census_budget=lambda ctx: (
         pow2_budget(ctx.cohort_buckets) * pow2_budget(ctx.nb_census)
@@ -117,14 +149,15 @@ def build_group_fn(
 def _audit_group_fn_cases(ctx):
     """`fedml-tpu audit` provider: the EXACT per-(bucket, nb) group
     computation the planet loop jits, lowered across the two-axis pow2
-    census with no registry and no data."""
+    census with no registry and no data — donation of the per-group
+    ``global_params`` rebind included."""
     import jax
 
     from ..analysis.compiled import LoweringCase
 
     fn = jax.jit(build_group_fn(
         ctx.local_train_fn(), edge_num=ctx.edge_num,
-    ))
+    ), donate_argnums=(0,))
     params = ctx.abstract_params()
     E = max(1, ctx.edge_num)
     return [
@@ -167,6 +200,12 @@ class PlanetRoundLoop:
         self.api = api
         args = api.args
         self._validate(api)
+        # persistent compilation cache: the (bucket, nb) census is
+        # exactly the executable set a 10k-cohort world re-compiles on
+        # every cold start — idempotent, shared with the api's own call
+        from ..core.compile_cache import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache(args)
         self.cohort_size = int(
             getattr(args, "cohort_size", 0) or 0
         ) or int(args.client_num_per_round)
@@ -199,10 +238,16 @@ class PlanetRoundLoop:
 
     @staticmethod
     def _validate(api) -> None:
+        from ..parallel.layout import is_fed_mesh
+
         args = api.args
         unsupported = []
-        if getattr(api, "mesh", None) is not None:
-            unsupported.append("mesh simulation")
+        if getattr(api, "mesh", None) is not None and not is_fed_mesh(api.mesh):
+            # the fed (data, fsdp) mesh shards the (bucket, nb) group
+            # fns across the chips (ROADMAP item 1); the legacy
+            # 'clients' mesh pre-shards an eager federation tensor this
+            # loop never builds
+            unsupported.append("the legacy (clients) mesh")
         if getattr(api, "server_aggregator", None) is not None:
             unsupported.append("a custom server_aggregator")
         if getattr(api, "robust", None) is not None:
@@ -240,8 +285,9 @@ class PlanetRoundLoop:
             api._local_train,
             edge_num=self.edge_num,
             use_round_lr=api._round_lr is not None,
+            mesh=getattr(api, "mesh", None),
             on_trace=on_trace,
-        ))
+        ), donate_argnums=(0,))
 
     # -- round loop ---------------------------------------------------
     def run(
@@ -273,11 +319,22 @@ class PlanetRoundLoop:
         waste_fracs: List[float] = []
         x_dtype = api.dataset.test_data_global.x.dtype
 
+        mesh = getattr(api, "mesh", None)
         profiler = getattr(api, "_round_profiler", None)
         for round_idx in range(start_round, comm_rounds):
             if profiler is not None:
                 profiler.tick(round_idx)
             t0 = time.perf_counter()
+            # the per-round donated carry: every group call rebinds it
+            # (gp, terms, ... = group_fn(gp, ...)) so the model buffer
+            # is aliased through the whole round instead of copied per
+            # group. On a fed mesh the carry is placed fsdp-sharded at
+            # rest first (finalize hands back an unplaced host tree).
+            gp = api.global_params
+            if mesh is not None:
+                from ..parallel.layout import shard_tree
+
+                gp = shard_tree(gp, mesh)
             idx = self.registry.sample_cohort(round_idx, self.cohort_size)
             plan = pack_cohort(
                 self.registry.num_samples[idx],
@@ -334,8 +391,8 @@ class PlanetRoundLoop:
                 # mod E), not of its slot — stable across cohorts
                 onehot = np.zeros((group.bucket, E), dtype=np.float32)
                 onehot[np.arange(group.bucket), group.client_idx % E] = 1.0
-                terms, edge_w, m = self._group_fn(
-                    api.global_params,
+                gp, terms, edge_w, m = self._group_fn(
+                    gp,
                     batches,
                     jnp.asarray(group.num_samples),
                     jnp.asarray(group.valid),
@@ -343,13 +400,18 @@ class PlanetRoundLoop:
                     jax.random.fold_in(round_rng, g_i),
                     *extra,
                 )
-                edge_w = np.asarray(edge_w, dtype=np.float64)
+                # deliberate O(E)-scalar fetch: the per-edge fold
+                # weights drive host-side python fold bookkeeping
+                # (StreamingAccumulator.total_w is an exact python-
+                # float sum by design); the model-sized terms stay on
+                # device
+                edge_w = np.asarray(edge_w, dtype=np.float64)  # lint: host-sync-ok — O(E) scalars (comment above)
                 for e in range(E):
                     if edge_w[e] <= 0.0:
                         continue
                     term_e = jax.tree.map(lambda x: x[e], terms)
                     target = acc.acc(e) if tree is not None else acc
-                    target.fold_weighted_term(term_e, float(edge_w[e]))
+                    target.fold_weighted_term(term_e, float(edge_w[e]))  # lint: host-sync-ok — host numpy scalar
                 summed = (
                     m if summed is None
                     else jax.tree.map(jnp.add, summed, m)
@@ -380,6 +442,7 @@ class PlanetRoundLoop:
             "rounds": comm_rounds - start_round,
             "trace_count": self._trace_count,
             "shape_keys": sorted(self._shape_keys_seen),
+            # lint: host-sync-ok — waste_fracs is a host list of python floats
             "waste_frac_mean": float(np.mean(waste_fracs))
             if waste_fracs else 0.0,
         }
@@ -416,7 +479,9 @@ class PlanetRoundLoop:
             "round_time_s": time.perf_counter() - t0,
         }
         if summed is not None:
-            stats["train_loss_cohort"] = float(summed["loss_sum"]) / max(
-                float(summed["count"]), 1.0
+            # eval-round metric fetch: metrics leave the device here by
+            # design (the eval cadence IS the sync cadence)
+            stats["train_loss_cohort"] = float(summed["loss_sum"]) / max(  # lint: host-sync-ok
+                float(summed["count"]), 1.0  # lint: host-sync-ok — same eval-round fetch
             )
         return stats

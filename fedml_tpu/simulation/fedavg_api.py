@@ -33,6 +33,7 @@ from ..analysis.compiled import auditable, pow2_budget
 from ..core.frame import bind_operator
 from ..core.aggregation import (
     RobustAggregator,
+    exact_weighted_mean,
     normalize_weights,
     weighted_average,
 )
@@ -86,7 +87,20 @@ def build_round_fn(
     params and server-optimizer state — are donated by every caller's
     ``jax.jit(round_fn, donate_argnums=(0, 1))``; the round pipeline
     chains K rounds in flight on those buffers.
+
+    Mesh dispatch: a legacy ``(clients[, data])`` mesh keeps the
+    original client-axis sharding; a fed ``(data, fsdp)`` mesh
+    (``parallel/layout.py``) shards the cohort along ``data``, keeps
+    the params fsdp-sharded AT REST while gathering them replicated
+    for per-client compute (FSDP at-use gather — no tensor-parallel
+    reduction ever splits a client's math, which is what keeps the
+    mesh round bitwise identical to the single-chip vmap path), and
+    pins the aggregated output back onto the fsdp layout so the
+    chained/donated carry never leaves the mesh.
     """
+    from ..parallel.layout import is_fed_mesh
+
+    fed = mesh is not None and is_fed_mesh(mesh)
 
     def round_fn(
         global_params, server_state, packed: Batches, nsamples, idx, rng,
@@ -110,7 +124,26 @@ def build_round_fn(
                 y=cohort.y,
                 mask=cohort.mask * vm.astype(cohort.mask.dtype),
             )
-        if mesh is not None:
+        train_params = global_params
+        if fed:
+            from ..parallel.layout import fed_compute_constraints
+
+            # the shared fed entry discipline (cohort along 'data',
+            # params + sample counts + validity mask gathered
+            # replicated — the FSDP at-use gather; params stay
+            # fsdp-sharded at rest in the carry). valid MUST be
+            # lane-invariant too: normalize_weights reduces w * valid,
+            # and a data-sharded [C] vector there would turn the
+            # normalizer into shape-dependent partial sums + psum
+            if valid is not None:
+                train_params, cohort, ns, valid = fed_compute_constraints(
+                    mesh, global_params, cohort, ns, valid
+                )
+            else:
+                train_params, cohort, ns = fed_compute_constraints(
+                    mesh, global_params, cohort, ns
+                )
+        elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ..parallel.mesh import federation_spec
@@ -131,15 +164,28 @@ def build_round_fn(
             # round-indexed LR: one multiplier for the whole cohort
             new_stacked, train_metrics = jax.vmap(
                 local_train, in_axes=(None, 0, 0, None)
-            )(global_params, cohort, rngs, lr_mult)
+            )(train_params, cohort, rngs, lr_mult)
         else:
             new_stacked, train_metrics = jax.vmap(
                 local_train, in_axes=(None, 0, 0)
-            )(global_params, cohort, rngs)
+            )(train_params, cohort, rngs)
+        if fed:
+            from ..parallel.layout import pin_cohort_outputs
+
+            # per-client compute stays whole; only the at-rest carry
+            # is fsdp-sharded (see pin_cohort_outputs)
+            new_stacked = pin_cohort_outputs(mesh, new_stacked)
         weights = normalize_weights(ns, valid)
         new_global, new_state = aggregate(
             global_params, server_state, new_stacked, weights, cohort, rng
         )
+        if fed:
+            from ..parallel.layout import constrain_tree
+
+            # the aggregated carry lands fsdp-sharded at rest — the
+            # donated (0, 1) chain never leaves the mesh, so zero host
+            # hops at any cohort size (BENCH_r03's 573x prize)
+            new_global = constrain_tree(new_global, mesh)
         summed = {k: v.sum() for k, v in train_metrics.items()}
         if keep_stacked:
             return new_global, new_state, summed, new_stacked
@@ -202,6 +248,71 @@ def _audit_round_fn_cases(ctx):
     ]
 
 
+@auditable(
+    "simulation.round_fn_mesh",
+    donate=(0, 1),
+    round_shaped=True,
+    census_budget=lambda ctx: pow2_budget(ctx.cohort_buckets),
+)
+def _audit_round_fn_mesh_cases(ctx):
+    """`fedml-tpu audit` provider for the MESH round engine: the same
+    builder the runtime jits, with the fed (data, fsdp) mesh built
+    over whatever devices exist (CI lowers on one CPU device — a 1x1
+    mesh; the sharding annotations, the (0, 1) donation aliasing and
+    the host-transfer freedom of the lowered module are checked
+    identically at any mesh size). The aggregation lowered here is the
+    exact expansion fold the mesh path really runs
+    (``exact_weighted_mean``) — zero host hops inside the round is a
+    compile-time fact, not a benchmark observation."""
+    import jax
+
+    from ..analysis.compiled import LoweringCase
+    from ..parallel.layout import build_fed_mesh, tree_shardings
+
+    n = len(jax.devices())
+    fsdp = 2 if n % 2 == 0 else 1
+    mesh = build_fed_mesh(
+        mesh_shape={"data": n // fsdp, "fsdp": fsdp},
+        # lowering only — nothing executes, so the threefry stream
+        # warning would be CI noise
+        warn_nonpartitionable=False,
+    )
+    # lower against fsdp-AT-REST input shardings — what the runtime
+    # commits (SimulatorMesh.shard_tree). Donation aliasing only
+    # exists when the donated input's layout matches the constrained
+    # output's, so an unsharded abstract input would under-report the
+    # aliasing the real executable has (observed on the 8-device test
+    # world: 0 of 2 aliased without this)
+    params = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        ctx.abstract_params(),
+        tree_shardings(ctx.abstract_params(), mesh),
+    )
+
+    def aggregate(global_params, server_state, stacked, weights, cohort, rng):
+        return exact_weighted_mean(stacked, weights), server_state
+
+    fn = jax.jit(
+        build_round_fn(ctx.local_train_fn(), aggregate, mesh=mesh),
+        donate_argnums=(0, 1),
+    )
+    n_total = max(ctx.cohort_buckets) * 2
+    packed = ctx.abstract_batches(n_total)
+    nsamples = ctx.sds((n_total,), "float32")
+    return [
+        LoweringCase(
+            key=f"b{b}",
+            fn=fn,
+            args=(
+                params, (), packed, nsamples,
+                ctx.sds((b,), "int32"), ctx.abstract_key(),
+            ),
+            kwargs={"valid": ctx.sds((b,), "float32")},
+        )
+        for b in ctx.cohort_buckets
+    ]
+
+
 def deterministic_client_sampling(
     round_idx: int, client_num_in_total: int, client_num_per_round: int
 ) -> np.ndarray:
@@ -213,6 +324,7 @@ def deterministic_client_sampling(
     if client_num_in_total == client_num_per_round:
         return np.arange(client_num_in_total, dtype=np.int32)
     rs = np.random.RandomState(round_idx)
+    # lint: host-sync-ok — rs.choice output is host numpy, no device value
     return np.asarray(
         rs.choice(range(client_num_in_total), client_num_per_round, replace=False),
         dtype=np.int32,
@@ -253,11 +365,25 @@ class FedAvgAPI:
         self.model = model
         self.mesh = mesh
         if mesh is not None:
+            from ..parallel.layout import is_fed_mesh
             from ..parallel.mesh import is_multi_controller
 
             self._multi_controller = is_multi_controller(mesh)
+            # fed (data, fsdp) mesh: params shard at rest, the cohort
+            # shards along 'data', and the plain-FedAvg aggregation
+            # switches to the exact placement-independent expansion
+            # fold (core/aggregation.exact_weighted_mean)
+            self._fed_mesh = is_fed_mesh(mesh)
         else:
             self._multi_controller = False
+            self._fed_mesh = False
+        # persistent XLA compilation cache (core/compile_cache.py):
+        # no-op unless args.compile_cache_dir is set; idempotent
+        # process-wide, so every engine (sync loop, round pipeline,
+        # planet loop, serving) shares one warm-start ledger
+        from ..core.compile_cache import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache(args)
         if server_aggregator is not None and not self._accepts_custom_aggregator:
             raise ValueError(
                 f"{self.algorithm} defines its own server aggregation; a "
@@ -328,6 +454,29 @@ class FedAvgAPI:
         self.robust = (
             RobustAggregator(args) if getattr(args, "defense_type", None) else None
         )
+        if self._fed_mesh and (
+            self.robust is not None
+            or self.server_aggregator is not None
+            or type(self)._aggregate is not FedAvgAPI._aggregate
+        ):
+            # the mesh-shape bitwise-identity guarantee rides the exact
+            # expansion fold, which only the plain FedAvg/FedProx
+            # reduction uses; every other aggregation reduces the
+            # sharded cohort through weighted_average-style ops whose
+            # psum order depends on the mesh shape. Results are still
+            # correct to float tolerance — but the degradation must be
+            # LOUD, never discovered in a diff (docs/multichip.md)
+            logging.warning(
+                "(data, fsdp) mesh with %s: aggregation does not go "
+                "through the exact expansion fold, so final params are "
+                "correct to float tolerance but NOT bitwise identical "
+                "across mesh shapes (the detail.multichip identity "
+                "gate covers the plain FedAvg/FedProx path only)",
+                "defense_type" if self.robust is not None
+                else ("a custom server_aggregator"
+                      if self.server_aggregator is not None
+                      else f"algorithm {self.algorithm}"),
+            )
         self.server_state = self._init_server_state()
         self._build_jitted()
 
@@ -373,6 +522,13 @@ class FedAvgAPI:
                 self.robust.aggregate(new_stacked, weights, global_params, rng),
                 server_state,
             )
+        if getattr(self, "_fed_mesh", False):
+            # the (data, fsdp) mesh path: a plain weighted_average over
+            # a sharded client axis becomes partial sums + psum, whose
+            # bits depend on the mesh shape. The exact expansion fold
+            # is placement-independent, so every mesh shape — including
+            # {data: 1} — finalizes to identical float32 params
+            return exact_weighted_mean(new_stacked, weights), server_state
         return weighted_average(new_stacked, weights), server_state
 
     def _preprocess(self, cohort: Batches, server_state):
@@ -447,7 +603,9 @@ class FedAvgAPI:
             # device arrays (every process holds the same host copy)
             packed = self.dataset.packed_train
             nsamples = (
-                np.asarray(self.dataset.packed_num_samples)
+                # one pre-loop conversion to a process-consistent host
+                # value (multi-controller jit-input rule, comment above)
+                np.asarray(self.dataset.packed_num_samples)  # lint: host-sync-ok
                 if self._multi_controller
                 else jnp.asarray(self.dataset.packed_num_samples)
             )
@@ -489,7 +647,8 @@ class FedAvgAPI:
         if self._round_lr is None:
             return None
         return np.float32(
-            float(self._round_lr(round_idx)) / float(self.args.learning_rate)
+            # lint: host-sync-ok — the schedule and the knob are host scalars
+            float(self._round_lr(round_idx)) / float(self.args.learning_rate)  # lint: host-sync-ok
         )
 
     def _train_rounds(
@@ -540,7 +699,7 @@ class FedAvgAPI:
             )
             self.rng, round_rng = jax.random.split(self.rng)
             if self._multi_controller:
-                round_rng = np.asarray(round_rng)  # process-consistent host value
+                round_rng = np.asarray(round_rng)  # lint: host-sync-ok — process-consistent host value (multi-controller rule)
             lr_mult = self._lr_mult(round_idx)
             with self.profiler.span("round"):
                 if self.mode == "sequential":
@@ -555,7 +714,7 @@ class FedAvgAPI:
                         self.server_state,
                         packed,
                         nsamples,
-                        np.asarray(idx) if self._multi_controller else jnp.asarray(idx),
+                        np.asarray(idx) if self._multi_controller else jnp.asarray(idx),  # lint: host-sync-ok — idx is host numpy (sampling)
                         round_rng,
                         *extra,
                     )
@@ -567,8 +726,10 @@ class FedAvgAPI:
                     stats = self._local_test_on_all_clients(round_idx)
                 stats["round"] = round_idx
                 stats["round_time_s"] = time.perf_counter() - t0
-                stats["train_loss_cohort"] = float(summed["loss_sum"]) / max(
-                    float(summed["count"]), 1.0
+                # eval-round metric fetch: the sync loop fetches at its
+                # eval cadence by design (the pipelined loop defers)
+                stats["train_loss_cohort"] = float(summed["loss_sum"]) / max(  # lint: host-sync-ok
+                    float(summed["count"]), 1.0  # lint: host-sync-ok — same eval-round fetch
                 )
                 self.history.append(stats)
                 final_stats = stats
@@ -604,7 +765,7 @@ class FedAvgAPI:
                 self.server_state, restored["server_state"]
             )
             self.rng = jnp.asarray(restored["rng"], dtype=jnp.uint32)
-            start_round = int(restored["round_idx"]) + 1
+            start_round = int(restored["round_idx"]) + 1  # lint: host-sync-ok — restore-time scalar, once per run
             self._restore_extra_state(restored.get("extra"))
             logging.info("resuming from round %d", start_round)
         self._to_state_dict = to_state_dict

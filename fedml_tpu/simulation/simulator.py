@@ -88,7 +88,17 @@ def _log_pipeline_stats(fl_trainer) -> None:
 
 
 class SimulatorMesh:
-    """Client-parallel FL over a device mesh."""
+    """Client-parallel FL over a device mesh.
+
+    Two mesh vocabularies, picked by ``args.mesh_shape``:
+
+    - legacy ``{clients[, data]}`` — cohort sharded over ``clients``,
+      params replicated (single-chip HBM bound);
+    - fed ``{data[, fsdp]}`` (``parallel/layout.py``) — the production
+      plane: cohort over ``data``, params/optimizer state fsdp-sharded
+      at rest per the ``SpecLayout`` table, aggregation on-mesh via
+      the exact expansion fold — bitwise identical across mesh shapes.
+    """
 
     def __init__(
         self,
@@ -100,15 +110,30 @@ class SimulatorMesh:
         client_trainer=None,
         server_aggregator=None,
     ) -> None:
-        self.args = args
-        self.mesh = mesh if mesh is not None else build_mesh(
-            mesh_shape=getattr(args, "mesh_shape", None)
+        from ..parallel.layout import (
+            build_fed_mesh,
+            cohort_axis_size,
+            fed_mesh_shape,
+            is_fed_mesh,
         )
-        n_client_shards = self.mesh.shape.get("clients", 1)
+
+        self.args = args
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            shape = getattr(args, "mesh_shape", None)
+            self.mesh = (
+                build_fed_mesh(mesh_shape=shape)
+                if fed_mesh_shape(shape)
+                else build_mesh(mesh_shape=shape)
+            )
+        fed = is_fed_mesh(self.mesh)
+        n_client_shards = cohort_axis_size(self.mesh)
         if int(args.client_num_per_round) % n_client_shards != 0:
+            axis = "data" if fed else "clients"
             raise ValueError(
                 f"client_num_per_round={args.client_num_per_round} must be a "
-                f"multiple of the mesh 'clients' axis ({n_client_shards})"
+                f"multiple of the mesh {axis!r} axis ({n_client_shards})"
             )
         packed_train, ns_padded = pad_federation(
             dataset.packed_train, dataset.packed_num_samples, n_client_shards
@@ -137,9 +162,18 @@ class SimulatorMesh:
             mesh=self.mesh,
             **_operator_kwargs(cls, client_trainer, server_aggregator),
         )
-        self.fl_trainer.global_params = replicate(
-            self.fl_trainer.global_params, self.mesh
-        )
+        if fed:
+            # FSDP at-rest placement per the canonical layout table —
+            # each chip holds 1/fsdp of every sharded leaf
+            from ..parallel.layout import shard_tree
+
+            self.fl_trainer.global_params = shard_tree(
+                self.fl_trainer.global_params, self.mesh
+            )
+        else:
+            self.fl_trainer.global_params = replicate(
+                self.fl_trainer.global_params, self.mesh
+            )
 
     def run(self):
         from ..core.tracking import device_trace

@@ -205,6 +205,7 @@ GROUPS = [
     ("Parallelism (mesh / distributed)", [
         "mesh_shape", "sp_strategy", "sp_ring_block", "pp_microbatches",
         "moe_aux_weight", "grad_accum_steps", "matmul_precision",
+        "compile_cache_dir",
     ]),
     ("Device", ["using_gpu", "device_type", "gpu_mapping_file"]),
     ("Serving", [

@@ -107,6 +107,10 @@ PHASES = [
     ("sweep_256", ["--phase", "sweep", "--cohort", "256"], 300.0),
     ("sweep_512", ["--phase", "sweep", "--cohort", "512"], 360.0),
     ("mesh", ["--phase", "mesh"], 240.0),
+    # the (data, fsdp) production mesh: shape sweep + bitwise identity
+    # + on-mesh fold identity (on a 1-chip tunnel it records
+    # single_device_only — real scaling needs a pod slice window)
+    ("multichip", ["--phase", "multichip"], 420.0),
     ("telemetry", ["--phase", "telemetry"], 300.0),
     ("serving", ["--phase", "serving"], 300.0),
     ("tracing", ["--phase", "tracing"], 300.0),

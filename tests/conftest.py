@@ -28,6 +28,7 @@ def _reset_observability_singletons():
     """The tracking/telemetry singletons are process-wide; without a
     reset, one test's args (or counters, heartbeats, watchdog) leak
     into every later test in the worker."""
+    prev_threefry = jax.config.jax_threefry_partitionable
     yield
     from fedml_tpu.core.chaos import reset_chaos
     from fedml_tpu.core.telemetry import Telemetry
@@ -38,6 +39,11 @@ def _reset_observability_singletons():
     RunLogger.reset()
     # the chaos plane (schedule + durable-IO seam) is process-global
     reset_chaos()
+    # building a fed (data, fsdp) mesh flips jax_threefry_partitionable
+    # process-wide (sharding-invariant random draws); restore it so a
+    # mesh test can never shift another test's seeded stream
+    if jax.config.jax_threefry_partitionable != prev_threefry:
+        jax.config.update("jax_threefry_partitionable", prev_threefry)
 
 
 @pytest.fixture(scope="session")

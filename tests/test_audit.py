@@ -319,6 +319,7 @@ class TestRepoAtHead:
         reg = load_registry()
         assert {
             "simulation.round_fn",
+            "simulation.round_fn_mesh",
             "planet.group_fn",
             "serving.forward",
             "agg.fold_tree",
@@ -326,10 +327,20 @@ class TestRepoAtHead:
             "agg.weighted_term_clipped",
             "agg.weighted_delta_term_clipped",
         } <= set(reg)
-        # the round/fold executables CLAIM donation; the auditor holds
-        # them to it (test below proves the claims verify)
+        # the round/fold/group executables CLAIM donation; the auditor
+        # holds them to it (test below proves the claims verify)
         assert reg["simulation.round_fn"].donate == (0, 1)
+        assert reg["simulation.round_fn_mesh"].donate == (0, 1)
+        assert reg["planet.group_fn"].donate == (0,)
         assert reg["agg.fold_tree"].donate == (0,)
+
+    def test_audit_baseline_is_empty(self):
+        """The donation burn-down is COMPLETE: planet.group_fn's
+        per-group rebind donates its carry, so the ledger holds zero
+        accepted TODOs. The ratchet therefore fails on ANY new
+        compile-time contract violation — nothing is grandfathered."""
+        baseline = load_baseline(os.path.join(REPO, AUDIT_BASELINE_NAME))
+        assert baseline == {}
 
     def test_repo_audits_clean_against_checked_in_baseline(self):
         """Every registered executable lowers; donation verified (or
@@ -353,9 +364,16 @@ class TestRepoAtHead:
         for e in report["executables"]:
             assert e["flops"] is not None and e["flops"] > 0
             assert e["bytes_accessed"] is not None
-        # donation PROVEN on the round/fold executables (not baselined)
-        for e in by_name["simulation.round_fn"] + by_name["agg.fold_tree"]:
-            assert e["aliased_inputs"] == e["claimed_donated_leaves"] > 0
+        # donation PROVEN on the round/fold/mesh/group executables —
+        # the baseline is EMPTY, nothing donation-shaped is
+        # grandfathered anymore
+        for e in (
+            by_name["simulation.round_fn"]
+            + by_name["simulation.round_fn_mesh"]
+            + by_name["planet.group_fn"]
+            + by_name["agg.fold_tree"]
+        ):
+            assert e["aliased_inputs"] >= e["claimed_donated_leaves"] > 0
         # hot executables are host-transfer-free across the census
         assert all(not e["host_transfers"] for e in report["executables"])
         assert report["roofline"]
@@ -370,17 +388,16 @@ class TestRepoAtHead:
             run_audit(only=["nope.missing"])
 
     def test_only_subset_ratchets_against_filtered_baseline(self):
-        """--only must keep the selected executable's accepted TODOs
-        in force (exit 0 for the baselined planet.group_fn finding)
-        while ignoring other specs' entries — never report the
-        baselined finding as raw."""
+        """--only runs ratchet against the subset's (now empty) ledger
+        slice: the once-baselined planet.group_fn donates its per-group
+        rebind since the mesh refactor, so both a formerly-TODO'd and a
+        finding-free executable exit clean, and neither run misreads
+        the other's (absent) entries as stale."""
         from fedml_tpu.analysis.audit import main
 
-        # planet.group_fn's zero-aliasing TODO is baselined: clean run
         assert main(["--only", "planet.group_fn"]) == 0
-        # a finding-free executable is clean too (and the group-fn
-        # baseline entries must not read as stale in its subset run)
         assert main(["--only", "agg.weighted_term"]) == 0
+        assert main(["--only", "simulation.round_fn_mesh"]) == 0
 
     @pytest.mark.slow  # subprocess pays interpreter + jax startup
     def test_cli_audit_ci_exits_zero_at_head(self, tmp_path):

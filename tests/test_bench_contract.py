@@ -162,6 +162,22 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"tracing"' in parent or "'tracing'" in parent
 
+    def test_multichip_phase_contract(self):
+        """detail.multichip ships the mesh-sharded federation evidence
+        (rounds/s + clients/s per (data, fsdp) mesh shape, every
+        sharded shape bitwise identical to the single-chip vmap world,
+        the streaming fold order-independent on-mesh for raw and int8
+        uplinks): the phase is in the child vocabulary and the parent
+        stitches it (like planet, it runs demoted on the CPU fallback,
+        where the child forces 8 virtual host devices)."""
+        assert "multichip" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"multichip"' in parent or "'multichip'" in parent
+        child = inspect.getsource(bench._phase_main)
+        assert "8 if a.phase == \"multichip\"" in child
+
     def test_hier_phase_contract(self):
         """detail.hier ships the hierarchical-server-plane evidence
         (uploads/s scaling vs edge count under a slow root link,
@@ -450,6 +466,40 @@ class TestPhaseChild:
         assert d["one_trace_per_shape"] is True
         assert d["trace_within_budget"] is True
         assert d["trace_count"] <= d["trace_budget"]
+
+    @pytest.mark.slow  # ~30s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's multichip smoke block
+    def test_multichip_smoke_child_writes_valid_json(self):
+        """The CI multichip smoke invocation (8 forced host devices,
+        cohort 16, 3 rounds, CPU): the mesh-sharded federation runs
+        end-to-end through bench.py's multichip phase child and emits
+        the detail.multichip contract keys — rounds/s and clients/s
+        per (data, fsdp) mesh shape, EVERY sharded shape's final
+        params bitwise identical (max_abs_diff == 0.0) to the
+        single-chip vmap world, one jit trace per shape, and the
+        on-mesh streaming fold bitwise order-independent for raw and
+        int8 uplinks (stream ≡ buffered preserved on the mesh; the
+        zero-host-transfer half of the gate is `fedml-tpu audit --ci`
+        over simulation.round_fn_mesh, run by the same CI script)."""
+        d = self._run_child("multichip", 500, smoke=True)
+        assert d["n_devices"] == 8
+        assert d["cohort_size"] == 16
+        assert d["rounds"] == 3
+        assert set(d["shapes"]) == {"1x1", "8x1", "4x2", "2x4"}
+        for key, entry in d["shapes"].items():
+            assert entry["rounds_per_sec"] > 0
+            assert entry["clients_per_sec"] > 0
+            assert entry["trace_count"] == 1
+            if key != "1x1":
+                assert entry["max_abs_diff_vs_single_chip"] == 0.0
+                assert entry["identical_to_single_chip"] is True
+        assert d["one_trace_per_shape"] is True
+        assert d["mesh_identical_to_single_chip"] is True
+        assert d["max_abs_diff_stream_raw"] == 0.0
+        assert d["max_abs_diff_stream_int8"] == 0.0
+        assert d["agg_stream_raw_identical"] is True
+        assert d["agg_stream_int8_identical"] is True
+        assert "simulation.round_fn_mesh" in d["mesh_executables_registered"]
 
     @pytest.mark.slow  # ~35s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's hier smoke block
