@@ -81,7 +81,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
     "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
-    "chaosplan", "planet", "hier", "multichip",
+    "chaosplan", "planet", "hier", "multichip", "crossdevice",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -3238,6 +3238,171 @@ def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def run_crossdevice(on_cpu: bool, smoke: bool = False) -> dict:
+    """Cross-device Beehive phase (docs/cross_device.md): churn-is-
+    normal connectionless federation over a 100k-device registry.
+
+    One scripted world: every round, 30% of the sampled cohort is
+    scheduled to vanish at ``device.upload`` (churn, not faults — the
+    round must CLOSE ON ITS FOLD TARGET anyway, never stall), with
+    pairwise-masked secure aggregation and Shamir dropout recovery for
+    the vanished maskers. The gates:
+
+    - every round closes with reason ``target`` at or above its fold
+      target (a million flaky phones cannot stall a round);
+    - the masked world's final params are BITWISE identical to an
+      unmasked world under the same schedule (masks cancel exactly in
+      the mod-p fold; recovery corrections are exact);
+    - the WAL fold ledger matches the fold counter exactly
+      (at-most-once fold), and ``fedml-tpu check`` (the offline
+      invariant checker) exits green over the run's artifacts;
+    - one jit trace per (speed tier, pow2 bucket) — the compile
+      census a heterogeneous device population presents.
+
+    ``smoke`` (CI gate): 64-device cohorts instead of 256; same
+    choreography in seconds."""
+    import tempfile as _tempfile
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.cli import main as cli_main
+    from fedml_tpu.core.chaos import reset_chaos
+    from fedml_tpu.core.invariants import InvariantChecker
+    from fedml_tpu.core.telemetry import Telemetry
+    from fedml_tpu.cross_device import run_beehive_world
+    from fedml_tpu.scale.registry import ClientRegistry
+
+    registry_size = 100_000
+    cohort = 64 if smoke else 256
+    rounds = 3
+    feature_dim, class_num = 8, 4
+
+    # precompute each round's cohort from a twin registry and schedule
+    # 30% of it to vanish mid-round (the chaos plane is deterministic:
+    # both worlds replay the identical churn)
+    twin = ClientRegistry(registry_size, seed=0, duty_hours=14)
+    schedule = []
+    vanish_per_round = {}
+    for r in range(rounds):
+        ids = twin.sample_available_cohort(r, cohort)
+        k = max(1, int(0.3 * len(ids)))
+        vanish_per_round[r] = k
+        for d in ids[:k]:
+            schedule.append(
+                {
+                    "at": {
+                        "event": "device.upload",
+                        "device": int(d),
+                        "round": r,
+                    },
+                    "fault": {"kind": "vanish"},
+                }
+            )
+
+    def beehive_world(masked: bool, run_id: str) -> dict:
+        a = Arguments()
+        a.training_type = "simulation"
+        a.run_id = run_id
+        a.client_registry_size = registry_size
+        a.crossdevice_cohort = cohort
+        a.comm_round = rounds
+        a.crossdevice_secure_agg = masked
+        a.chaos_schedule = schedule
+        a.telemetry_dir = _tempfile.mkdtemp(prefix="bench_xdev_td_")
+        a.checkpoint_dir = _tempfile.mkdtemp(prefix="bench_xdev_ck_")
+        a._validate()
+        fedml_tpu.init(a)
+        Telemetry.reset()
+        reset_chaos()
+        t0 = time.perf_counter()
+        world = run_beehive_world(
+            a, feature_dim=feature_dim, class_num=class_num
+        )
+        world["wall_s"] = time.perf_counter() - t0
+        world["telemetry_dir"] = a.telemetry_dir
+        world["checkpoint_dir"] = a.checkpoint_dir
+        tel = Telemetry.get_instance(a)
+        world["counters"] = {
+            name: tel.get_counter(name)
+            for name in (
+                "device_checkins_total",
+                "device_uploads_folded_total",
+                "device_uploads_late_total",
+                "device_duplicate_uploads_total",
+                "device_mask_recoveries_total",
+                "device_mask_recovery_failures_total",
+            )
+        }
+        return world
+
+    masked = beehive_world(True, "bench-xdev-masked")
+    _progress(
+        f"crossdevice masked world: {len(masked['round_records'])} rounds "
+        f"in {masked['wall_s']:.1f}s"
+    )
+    records = masked["round_records"]
+    closes_on_target = all(
+        rec["close_reason"] == "target" and rec["folds"] >= rec["fold_target"]
+        for rec in records
+    )
+    folds_total = sum(rec["folds"] for rec in records)
+    ledger_matches_counters = (
+        masked["counters"]["device_uploads_folded_total"] == folds_total
+    )
+    one_trace_per_shape = masked["trace_count"] == len(masked["shape_keys"])
+    checker = InvariantChecker(
+        telemetry_dir=masked["telemetry_dir"],
+        checkpoint_dir=masked["checkpoint_dir"],
+    ).check()
+    check_rc = cli_main(
+        [
+            "check",
+            "--telemetry-dir", masked["telemetry_dir"],
+            "--checkpoint-dir", masked["checkpoint_dir"],
+        ]
+    )
+
+    unmasked = beehive_world(False, "bench-xdev-unmasked")
+    diff = float(
+        np.max(np.abs(masked["final_flat"] - unmasked["final_flat"]))
+    )
+    _progress(
+        f"crossdevice identity: masked vs unmasked max_abs_diff={diff}"
+    )
+
+    out = {
+        "registry_size": registry_size,
+        "cohort": cohort,
+        "rounds": rounds,
+        "scheduled_vanish_per_round": vanish_per_round,
+        "round_records": records,
+        "closes_on_target": bool(closes_on_target),
+        "folds_per_s": round(folds_total / max(masked["wall_s"], 1e-9), 2),
+        "ledger_matches_counters": bool(ledger_matches_counters),
+        "mask_recoveries": masked["counters"]["device_mask_recoveries_total"],
+        "masked_vs_unmasked_max_abs_diff": diff,
+        "trace_count": masked["trace_count"],
+        "shape_keys": [list(k) for k in masked["shape_keys"]],
+        "one_trace_per_shape": bool(one_trace_per_shape),
+        "invariants_ok": bool(checker.ok),
+        "check_rc": int(check_rc),
+        "counters": masked["counters"],
+        "ok": bool(
+            closes_on_target
+            and ledger_matches_counters
+            and one_trace_per_shape
+            and diff == 0.0
+            and checker.ok
+            and check_rc == 0
+        ),
+    }
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_sweep_cohort(c: int) -> dict:
     """One scaling-sweep point (isolated in its own process)."""
     args, dataset, _model, api = _build_api(c, epochs=1, per_client=100)
@@ -3368,6 +3533,10 @@ _HIER_TIMEOUT_S = 480.0
 # cohorts; each world pays one sharded-compile + collective-emulation
 # round set) + the on-mesh fold identity section
 _MULTICHIP_TIMEOUT_S = 420.0
+# two Beehive worlds (masked + unmasked twin) over a 100k registry;
+# numpy field math dominates, jit compiles are per-(tier, bucket) on
+# a tiny linear model
+_CROSSDEVICE_TIMEOUT_S = 480.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -3678,6 +3847,12 @@ def _main_guarded() -> None:
     # preserved on-mesh for raw and int8 uplinks — replaces the
     # MULTICHIP_r0x dryrun JSONs with a measured gate
     _run_demoted_phase("multichip", _MULTICHIP_TIMEOUT_S)
+    # cross-device Beehive phase (connectionless check-in federation):
+    # 100k-registry worlds under a scheduled 30% mid-round vanish —
+    # every round closes on its fold target, pairwise-masked final
+    # params bitwise-identical to the unmasked twin, exactly-once fold
+    # ledger matching the counters, offline invariant checker green
+    _run_demoted_phase("crossdevice", _CROSSDEVICE_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -3836,6 +4011,8 @@ def _phase_main(argv) -> None:
         out = run_hier(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "multichip":
         out = run_multichip(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "crossdevice":
+        out = run_crossdevice(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     with open(a.out, "w") as fh:

@@ -153,3 +153,17 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_hier_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Cross-device Beehive smoke (100k-device registry, cohort 64 x 3
+# rounds, 30% scheduled mid-round vanish, CPU): the connectionless
+# check-in plane must run end-to-end through bench.py's crossdevice
+# phase child and emit the detail.crossdevice contract keys — every
+# round closing on its fold target despite the churn, the
+# pairwise-masked fold bitwise identical to the unmasked twin world
+# (Shamir dropout recovery included), the WAL fold ledger matching the
+# telemetry counters exactly, one jit trace per (speed tier, pow2
+# bucket), and the InvariantChecker plus fedml-tpu check green on the
+# run artifacts.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_crossdevice_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -441,6 +441,15 @@ _DEFAULTS: Dict[str, Any] = {
     "metrics_jsonl_path": None,  # also append metrics as JSONL here
     # cross-device control plane (cross_device/server.py)
     "cross_device_backend": constants.COMM_BACKEND_MQTT,
+    # cross-device Beehive check-in plane (cross_device/gateway.py)
+    "crossdevice_cohort": 0,  # devices sampled per round (0 = client_num_per_round)
+    "crossdevice_fold_target_frac": 0.6,  # fold-count fraction that closes a round
+    "crossdevice_report_window_s": 30.0,  # report window after the check-in phase
+    "crossdevice_secure_agg": True,  # pairwise-mask uploads (cancel in the fold)
+    "crossdevice_quant_scale": 65536.0,  # field quantization scale for deltas
+    "crossdevice_mask_threshold": 2,  # Shamir threshold for dropout recovery
+    "crossdevice_duty_hours": 14,  # diurnal on-window length per device
+    "crossdevice_verify_pubkey": True,  # check revealed secrets against pubkeys
     "silo_backend": "LOCAL",  # hierarchical cross-silo in-silo fabric
     "silo_grpc_port_base": 9890,  # in-silo gRPC first port
     "silo_grpc_ipconfig_path": None,  # in-silo rank->ip CSV
@@ -910,6 +919,60 @@ class Arguments:
                     "quorum close at the root (round_quorum_frac/"
                     "round_grace_s); aggregation_deadline_s does not apply"
                 )
+        # -- cross-device Beehive check-in plane (cross_device/) -------
+        for int_key in ("crossdevice_cohort", "crossdevice_mask_threshold",
+                        "crossdevice_duty_hours"):
+            raw = getattr(self, int_key)
+            try:
+                setattr(self, int_key, int(raw or 0))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{int_key}={raw!r}: must be an integer"
+                ) from None
+        if self.crossdevice_cohort < 0:
+            raise ValueError(
+                f"crossdevice_cohort={self.crossdevice_cohort}: must be "
+                ">= 0 (0 = client_num_per_round)"
+            )
+        if self.crossdevice_mask_threshold < 1:
+            raise ValueError(
+                f"crossdevice_mask_threshold="
+                f"{self.crossdevice_mask_threshold}: must be >= 1 "
+                "(shares needed to reconstruct a vanished device's mask)"
+            )
+        if not 1 <= self.crossdevice_duty_hours <= 24:
+            raise ValueError(
+                f"crossdevice_duty_hours={self.crossdevice_duty_hours}: "
+                "must be in [1, 24] (hours per day a device is reachable)"
+            )
+        for float_key in ("crossdevice_fold_target_frac",
+                          "crossdevice_report_window_s",
+                          "crossdevice_quant_scale"):
+            raw = getattr(self, float_key)
+            try:
+                setattr(self, float_key, float(raw))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{float_key}={raw!r}: must be a number"
+                ) from None
+        if not 0.0 < self.crossdevice_fold_target_frac <= 1.0:
+            raise ValueError(
+                f"crossdevice_fold_target_frac="
+                f"{self.crossdevice_fold_target_frac}: must be in (0, 1] "
+                "(fraction of the offered cohort whose folds close a round)"
+            )
+        if self.crossdevice_report_window_s <= 0:
+            raise ValueError(
+                f"crossdevice_report_window_s="
+                f"{self.crossdevice_report_window_s}: must be > 0"
+            )
+        if self.crossdevice_quant_scale <= 0:
+            raise ValueError(
+                f"crossdevice_quant_scale={self.crossdevice_quant_scale}: "
+                "must be > 0"
+            )
+        self.crossdevice_secure_agg = bool(self.crossdevice_secure_agg)
+        self.crossdevice_verify_pubkey = bool(self.crossdevice_verify_pubkey)
 
     # -- niceties ------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:

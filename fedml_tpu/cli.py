@@ -294,6 +294,67 @@ def cmd_edge(args) -> int:
     return run_edge(a, dry_run=args.dry_run)
 
 
+def cmd_device(args) -> int:
+    """Run the cross-device Beehive federation (docs/cross_device.md).
+
+    Reads the federation config (``--cf``), builds the device registry,
+    and drives ``comm_round`` connectionless check-in rounds end to end
+    on the in-process fabric: check-in, int8 round offer, pairwise-
+    masked uploads, fold-target close, dropout recovery. ``--dry-run``
+    builds the registry and world wiring, prints one status JSON line,
+    and exits (the ``serve --dry-run`` smoke seam)."""
+    from .arguments import Arguments
+    from .cross_device.driver import run_beehive_world
+    from .cross_device.protocol import flat_dim
+    from .scale.registry import ClientRegistry
+
+    ns = argparse.Namespace(
+        yaml_config_file=args.cf or "",
+        rank=0,
+        role="server",
+        run_id=args.run_id,
+    )
+    a = Arguments(ns)
+    a._validate()
+    size = int(getattr(a, "client_registry_size", 0) or 0) or 10_000
+    registry = ClientRegistry(
+        size,
+        seed=int(getattr(a, "random_seed", 0) or 0),
+        duty_hours=int(getattr(a, "crossdevice_duty_hours", 14)),
+    )
+    feature_dim = int(args.feature_dim)
+    class_num = int(args.output_dim)
+    cohort = (
+        int(getattr(a, "crossdevice_cohort", 0) or 0)
+        or int(getattr(a, "cohort_size", 0) or 0)
+        or int(getattr(a, "client_num_per_round", 4))
+    )
+    status = {
+        "plane": "crossdevice",
+        "registry_size": registry.size,
+        "registry_bytes": registry.nbytes(),
+        "cohort": cohort,
+        "rounds": int(a.comm_round),
+        "fold_target_frac": float(a.crossdevice_fold_target_frac),
+        "secure_agg": bool(a.crossdevice_secure_agg),
+        "quant_scale": float(a.crossdevice_quant_scale),
+        "update_dim": flat_dim(feature_dim, class_num),
+    }
+    if args.dry_run:
+        print(json.dumps(status))
+        return 0
+    out = run_beehive_world(
+        a,
+        feature_dim=feature_dim,
+        class_num=class_num,
+        registry=registry,
+    )
+    status["round_records"] = out["round_records"]
+    status["trace_count"] = out["trace_count"]
+    print(json.dumps(status))
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Stitch a run's trace shards + analyze round critical paths.
 
@@ -415,6 +476,14 @@ def build_parser() -> argparse.ArgumentParser:
     edge.add_argument("--run-id", dest="run_id", default="0")
     edge.add_argument("--dry-run", action="store_true")
     edge.set_defaults(fn=cmd_edge)
+
+    device = sub.add_parser("device")
+    device.add_argument("--cf", "--yaml_config_file", dest="cf", default="")
+    device.add_argument("--feature-dim", type=int, default=8)
+    device.add_argument("--output-dim", type=int, default=4)
+    device.add_argument("--run-id", dest="run_id", default="0")
+    device.add_argument("--dry-run", action="store_true")
+    device.set_defaults(fn=cmd_device)
 
     trace = sub.add_parser("trace")
     trace.add_argument(

@@ -183,3 +183,40 @@ HIER_EVENT_DEAD = "dead"
 HIER_EVENT_LEAVE = "leave"
 HIER_EVENT_ONLINE = "online"
 HIER_EVENT_QUARANTINE = "quarantine_evidence"
+
+# Cross-device "Beehive" check-in protocol (fedml_tpu/cross_device/
+# gateway.py + device.py, docs/cross_device.md — the connectionless
+# churn-is-normal plane): a device CHECKs IN with its round-scoped mask
+# public key, pulls the ROUND_OFFER (current round, int8-codec params,
+# participant pubkeys, fold target + report window) if eligible, pushes
+# ONE masked quantized delta, and disappears — no heartbeats, no
+# failure detector. WINDOW_TICKs are the simulator's deterministic
+# stand-in for wall-clock window expiry; SHARE_REQUEST/REVEAL is the
+# dropout-recovery exchange (survivors reveal Shamir shares for
+# vanished maskers); ROUND_RESULT announces a close so the device
+# plane can advance. 70s decade.
+MSG_TYPE_D2S_DEVICE_CHECKIN = 70
+MSG_TYPE_S2D_ROUND_OFFER = 71
+MSG_TYPE_D2S_MASKED_UPLOAD = 72
+MSG_TYPE_D2S_WINDOW_TICK = 73
+MSG_TYPE_S2D_SHARE_REQUEST = 74
+MSG_TYPE_D2S_SHARE_REVEAL = 75
+MSG_TYPE_S2D_ROUND_RESULT = 76
+MSG_ARG_KEY_DEVICE_ID = "device_id"
+MSG_ARG_KEY_DEVICE_PUBKEY = "device_pubkey"
+MSG_ARG_KEY_MASKED_DELTA = "masked_delta"
+MSG_ARG_KEY_MASK_CHECKSUM = "mask_checksum"
+MSG_ARG_KEY_PARTICIPANTS = "participants"
+MSG_ARG_KEY_QUANT_SCALE = "quant_scale"
+MSG_ARG_KEY_SHARE_REVEALS = "share_reveals"
+MSG_ARG_KEY_WINDOW_PHASE = "window_phase"
+MSG_ARG_KEY_CLOSE_INFO = "close_info"
+
+# report-window phases a WINDOW_TICK may close (the check-in window
+# gathers participants; the report window bounds uploads)
+DEVICE_WINDOW_CHECKIN = "checkin"
+DEVICE_WINDOW_REPORT = "report"
+# round close reasons the gateway ledgers (target reached vs window
+# expired — never cohort completeness)
+DEVICE_CLOSE_TARGET = "target"
+DEVICE_CLOSE_WINDOW = "window"

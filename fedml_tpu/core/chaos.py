@@ -72,6 +72,7 @@ __all__ = [
     "reset_chaos",
     "maybe_install_chaos",
     "chaos_barrier",
+    "device_event",
     "comm_plan",
     "enumerate_crash_points",
     "crash_point_schedule",
@@ -98,8 +99,14 @@ class ProcessKilled(Exception):
 
 
 # the event vocabulary a schedule step may name; "barrier" matches the
-# named chaos_barrier() calls in the managers via its `name` ctx key
-EVENTS = ("send", "wal_create", "wal_append", "ckpt_publish", "barrier")
+# named chaos_barrier() calls in the managers via its `name` ctx key;
+# the "device.*" events are the cross-device churn plane's protocol
+# steps (docs/cross_device.md) — churn there is scheduled state, not a
+# detected fault
+EVENTS = (
+    "send", "wal_create", "wal_append", "ckpt_publish", "barrier",
+    "device.checkin", "device.upload",
+)
 
 # fault kinds by the exact event they apply to — a (kind, event) pair
 # outside this map would fire (count + trace) but apply NOTHING, so
@@ -116,6 +123,12 @@ _EVENT_FAULTS = {
     # a checkpoint publish is torn as a whole step (garbage content on
     # disk), not at a byte offset
     "ckpt_publish": ("kill_server", "torn_publish", "enospc", "latency"),
+    # cross-device churn: "vanish" makes the device silently skip the
+    # step (a no-show at check-in costs nothing; at upload it leaves a
+    # dangling pairwise mask for dropout recovery); "bad_share" poisons
+    # the Shamir share this device later reveals for a vanished masker
+    "device.checkin": ("vanish",),
+    "device.upload": ("vanish", "bad_share"),
 }
 _ALL_FAULTS = tuple(sorted({k for ks in _EVENT_FAULTS.values() for k in ks}))
 
@@ -130,8 +143,10 @@ _EVENT_MATCHERS = {
     "wal_create": (),
     "ckpt_publish": ("round",),
     "barrier": ("name", "round", "rank"),
+    "device.checkin": ("device", "round"),
+    "device.upload": ("device", "round"),
 }
-_MATCH_KEYS = ("round", "rank", "msg_type", "name", "kind")
+_MATCH_KEYS = ("round", "rank", "msg_type", "name", "kind", "device")
 
 
 def validate_schedule(spec, knob: str = "chaos_schedule") -> List[dict]:
@@ -409,6 +424,27 @@ def chaos_barrier(name: str, round: Optional[int] = None,  # noqa: A002
                 float(fault.get("delay_s", 0.1))
                 + sched.jitter(float(fault.get("jitter_s", 0.0)))
             )
+
+
+def device_event(
+    event: str, device: int, round: Optional[int] = None,  # noqa: A002
+) -> Optional[dict]:
+    """Consult the schedule at a cross-device protocol step
+    (``device.checkin`` / ``device.upload``) for one device. Returns
+    the fired fault mapping (``kind`` is ``"vanish"`` / ``"bad_share"``;
+    a vanish may carry ``after_close: true`` to arrive late instead of
+    never) or None; the DEVICE PLANE interprets it — a vanish is
+    scheduled churn the device simulator enacts by skipping the step,
+    never an exception (churn is the normal case there, not a failure).
+    No-op (one dict lookup) when no schedule is installed."""
+    sched = _ACTIVE
+    if sched is None:
+        return None
+    ctx: Dict[str, Any] = {"device": int(device)}
+    if round is not None:
+        ctx["round"] = int(round)
+    hits = sched.on_event(event, **ctx)
+    return hits[0] if hits else None
 
 
 def _apply_clock_skew(skew_s: float) -> None:

@@ -251,7 +251,18 @@ class InvariantChecker:
     # -- the check ----------------------------------------------------
     def check(self) -> InvariantReport:
         rep = InvariantReport()
-        sync = [r for r in self.wal_records if r.get("kind") != "publish"]
+        # cross-device rounds close on a fold TARGET by design — they
+        # must not flow into the sync-cohort accounting (where a
+        # partial close is a bug unless excused) but into their own
+        # masked-fold balance checks
+        xdev = [
+            r for r in self.wal_records if r.get("kind") == "crossdevice"
+        ]
+        sync = [
+            r
+            for r in self.wal_records
+            if r.get("kind") not in ("publish", "crossdevice")
+        ]
         publishes = [r for r in self.wal_records if r.get("kind") == "publish"]
         if not self.wal_records:
             rep.skip("wal_well_formed", "no round_wal.jsonl found")
@@ -263,6 +274,7 @@ class InvariantChecker:
         self._check_counters(rep, sync, publishes)
         self._check_chaos_trace(rep)
         self._check_edge_tier(rep, sync)
+        self._check_crossdevice(rep, xdev)
         return rep
 
     # -- multi-tier invariants (hierarchical server plane) ------------
@@ -757,6 +769,120 @@ class InvariantChecker:
                 )
         elif total_ledger:
             rep.skip("counters_cover_ledger", "no fold counters in snapshot")
+
+    # -- cross-device Beehive plane (cross_device/gateway.py) ---------
+    def _check_crossdevice(self, rep, xdev) -> None:
+        """The check-in plane's ledger discipline, re-proven offline.
+
+        ``device_fold_requires_checkin``: every folded device appears
+        in its round's check-in list (no fold without a ledgered
+        check-in). ``device_masked_folds_balance``: the round's field
+        checksum equals the sum of its upload checksums minus its
+        correction checksums mod p — the pairwise masks cancelled, in
+        the durable record, not just in memory.
+        ``device_round_close_accounted``: every close carries a legal
+        reason, a target close really met its target, and the ledger's
+        fold count matches the fold counter exactly (at-most-once
+        fold). ``device_mask_recovery_verified``: no reconstructed
+        mask secret ever contradicted its published key.
+        """
+        if not xdev:
+            for name in (
+                "device_fold_requires_checkin",
+                "device_masked_folds_balance",
+                "device_round_close_accounted",
+                "device_mask_recovery_verified",
+            ):
+                rep.skip(name, "no crossdevice records in the WAL")
+            return
+        prime = 2**31 - 1  # core.secure_agg.FIELD_PRIME
+        rep.note_checked("device_fold_requires_checkin")
+        rep.note_checked("device_masked_folds_balance")
+        rep.note_checked("device_round_close_accounted")
+        total_folds = 0
+        for i, rec in enumerate(xdev):
+            r = rec.get("round_idx")
+            checkins = set(rec.get("checkins") or [])
+            folded = list(rec.get("folded") or [])
+            total_folds += len(folded)
+            cohort = set(rec.get("cohort") or [])
+            if not checkins <= cohort:
+                rep.fail(
+                    "device_fold_requires_checkin",
+                    f"crossdevice record {i} (round {r}) checked in devices "
+                    "outside the sampled cohort",
+                    extra=sorted(checkins - cohort),
+                )
+            if not set(folded) <= checkins:
+                rep.fail(
+                    "device_fold_requires_checkin",
+                    f"crossdevice record {i} (round {r}) folded devices "
+                    "that never checked in",
+                    unledgered=sorted(set(folded) - checkins),
+                )
+            reason = rec.get("close_reason")
+            if reason not in ("target", "window"):
+                rep.fail(
+                    "device_round_close_accounted",
+                    f"crossdevice record {i} (round {r}) closed for "
+                    f"unknown reason {reason!r}",
+                )
+            elif reason == "target" and len(folded) < int(
+                rec.get("fold_target") or 0
+            ):
+                rep.fail(
+                    "device_round_close_accounted",
+                    f"crossdevice record {i} (round {r}) claims a target "
+                    f"close with {len(folded)} fold(s) under its target "
+                    f"{rec.get('fold_target')}",
+                )
+            if rec.get("masked"):
+                ups = sum(
+                    int(v) for v in (rec.get("upload_checksums") or {}).values()
+                )
+                corrs = sum(
+                    int(v)
+                    for v in (rec.get("correction_checksums") or {}).values()
+                )
+                want = (ups - corrs) % prime
+                got = int(rec.get("field_checksum") or 0)
+                if got != want:
+                    rep.fail(
+                        "device_masked_folds_balance",
+                        f"crossdevice record {i} (round {r}) field checksum "
+                        f"{got} != uploads-minus-corrections balance {want} "
+                        "— a mask survived the fold or a correction was "
+                        "misapplied",
+                    )
+        if not self.counters:
+            rep.skip(
+                "device_mask_recovery_verified", "no telemetry.jsonl found"
+            )
+            return
+        if self.counters_reset:
+            rep.skip(
+                "device_mask_recovery_verified",
+                "counters reset by a server restart; evidence may predate "
+                "the final snapshot",
+            )
+            return
+        folded_ctr = self._ctr("device_uploads_folded_total")
+        if folded_ctr and abs(folded_ctr - total_folds) > 1e-9:
+            rep.fail(
+                "device_round_close_accounted",
+                f"the WAL ledgers {total_folds} fold(s) but the fold "
+                f"counter saw {folded_ctr:g} — the at-most-once fold "
+                "ledger and the telemetry disagree",
+            )
+        rep.note_checked("device_mask_recovery_verified")
+        failures = self._ctr("device_mask_recovery_failures_total")
+        if failures > 0:
+            rep.fail(
+                "device_mask_recovery_verified",
+                f"{failures:g} reconstructed mask secret(s) contradicted "
+                "their published keys — a revealed share was bad, and the "
+                "round folded without that correction",
+            )
 
     # -- trace cross-check --------------------------------------------
     def _check_chaos_trace(self, rep) -> None:

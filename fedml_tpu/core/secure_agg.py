@@ -19,12 +19,17 @@ int64 exactly.
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence
+from typing import Any, Dict, List, Sequence
 
 import jax
 import numpy as np
 
 FIELD_PRIME = 2**31 - 1
+
+# generator for the pairwise-mask key exchange: 7 is a primitive root
+# of the Mersenne prime 2^31 - 1, so g^b ranges over the whole
+# multiplicative group
+MASK_GENERATOR = 7
 
 Params = Any
 
@@ -112,6 +117,109 @@ def additive_share(
     shares = rng.integers(0, p, size=(n - 1,) + x.shape, dtype=np.int64)
     last = np.mod(x - np.mod(shares.sum(axis=0), p), p)
     return np.concatenate([shares, last[None]], axis=0)
+
+
+# -- pairwise masking (SecAgg shape, cross-device plane) -------------------
+#
+# Each device derives a round-scoped secret b_i, publishes p_i = g^b_i,
+# and computes one shared seed per peer s_ij = p_j^b_i = g^(b_i*b_j)
+# (symmetric, so both ends expand the SAME pseudorandom field vector).
+# Device i's upload is its quantized delta plus
+# sum_{j != i} sign(i, j) * PRG(s_ij) with sign(i, j) = +1 iff i < j —
+# across any set that all uploaded, the signed terms cancel EXACTLY in
+# integer mod-p addition, which is what makes the masked streaming fold
+# bitwise identical to the unmasked one (proven in tests and the
+# detail.crossdevice bench). A device that checked in but never
+# uploaded leaves its pairwise terms dangling in everyone else's
+# uploads; survivors reveal Shamir shares of the vanished secret, the
+# server reconstructs b_v (verifying g^b_v against the published key),
+# regenerates the dangling terms, and subtracts them.
+
+
+def derive_mask_secret(
+    device_seed: int, round_idx: int, p: int = FIELD_PRIME
+) -> int:
+    """Round-scoped mask secret b in [1, p-2], deterministic per
+    (device seed, round) — replayable worlds need replayable masks."""
+    rs = np.random.RandomState(
+        (int(device_seed) * 2_654_435_761 + int(round_idx) * 97 + 13)
+        % (2**32)
+    )
+    return int(rs.randint(1, p - 1))
+
+
+def mask_public_key(
+    secret: int, p: int = FIELD_PRIME, g: int = MASK_GENERATOR
+) -> int:
+    """Published half of the pairwise key exchange: g^secret mod p."""
+    return int(modpow(np.int64(g), int(secret), p))
+
+
+def pairwise_seed(secret_i: int, public_j: int, p: int = FIELD_PRIME) -> int:
+    """Shared seed s_ij = p_j^b_i = g^(b_i*b_j) — symmetric, so both
+    devices expand the identical mask vector from it."""
+    return int(modpow(np.int64(public_j), int(secret_i), p))
+
+
+def prg_field_vector(seed: int, dim: int, p: int = FIELD_PRIME) -> np.ndarray:
+    """Deterministic pseudorandom field vector from a shared seed."""
+    rs = np.random.RandomState(int(seed) % (2**32))
+    return rs.randint(0, p, size=int(dim), dtype=np.int64)
+
+
+def pairwise_mask_vector(
+    device_id: int,
+    secret: int,
+    peer_publics: Dict[int, int],
+    dim: int,
+    p: int = FIELD_PRIME,
+) -> np.ndarray:
+    """Device ``device_id``'s total mask: the signed sum of its
+    pairwise PRG vectors against every peer, mod p. Adding this to the
+    quantized delta hides it; summed over any complete set of
+    participants the masks cancel to exactly zero."""
+    mask = np.zeros(int(dim), dtype=np.int64)
+    for j, pub_j in peer_publics.items():
+        if int(j) == int(device_id):
+            continue
+        r = prg_field_vector(pairwise_seed(secret, pub_j, p), dim, p)
+        if int(device_id) < int(j):
+            mask = np.mod(mask + r, p)
+        else:
+            mask = np.mod(mask - r, p)
+    return mask
+
+
+def unmask_correction(
+    vanished_id: int,
+    vanished_secret: int,
+    folded_publics: Dict[int, int],
+    dim: int,
+    p: int = FIELD_PRIME,
+) -> np.ndarray:
+    """The dangling-mask residue a vanished participant left in the
+    fold: sum over folded devices i of sign(i, v) * PRG(s_iv), mod p.
+    Subtracting this from the field total restores exact cancellation
+    (dropout recovery). Computed from the RECONSTRUCTED secret, so a
+    bad share surfaces as a pubkey-verification failure upstream."""
+    corr = np.zeros(int(dim), dtype=np.int64)
+    for i, pub_i in folded_publics.items():
+        if int(i) == int(vanished_id):
+            continue
+        r = prg_field_vector(
+            pairwise_seed(vanished_secret, pub_i, p), dim, p
+        )
+        if int(i) < int(vanished_id):
+            corr = np.mod(corr + r, p)
+        else:
+            corr = np.mod(corr - r, p)
+    return corr
+
+
+def field_checksum(q: np.ndarray, p: int = FIELD_PRIME) -> int:
+    """Sum of a field vector mod p — the per-upload balance witness the
+    masked-folds-balance invariant checks (docs/cross_device.md)."""
+    return int(np.mod(np.asarray(q, dtype=np.int64).sum(), p))
 
 
 # -- float <-> field quantization ------------------------------------------

@@ -1,17 +1,28 @@
 """Cross-device scenario ("Beehive" parity, SURVEY.md §2.11).
 
-Server-side round loop over a file-shipping protocol with non-JAX edge
-clients; the model-file boundary replaces the reference's .mnn round
-trip. See ``server.py`` / ``client_sim.py`` / ``model_file.py``.
+Two planes live here. The legacy file-shipping plane (``server.py`` /
+``client_sim.py`` / ``model_file.py``) mirrors the reference's .mnn
+round trip: a server-side round loop over non-JAX edge clients.
+
+The connectionless check-in plane (``gateway.py`` / ``device.py`` /
+``protocol.py`` / ``driver.py``, docs/cross_device.md) is the
+churn-is-normal federation for a registry-scale device population:
+devices check in, pull a round offer, push one pairwise-masked delta,
+and disappear — no heartbeats, no failure detector, no per-device
+server state beyond a bounded round ledger.
 """
 
 from .client_sim import EdgeClientSim  # noqa: F401
+from .device import DeviceHost  # noqa: F401
+from .driver import run_beehive_world  # noqa: F401
+from .gateway import DeviceGateway  # noqa: F401
 from .model_file import (  # noqa: F401
     model_bytes_to_params,
     params_to_model_bytes,
     read_model_file,
     write_model_file,
 )
+from .protocol import flat_dim, linear_template  # noqa: F401
 from .server import (  # noqa: F401
     CrossDeviceAggregator,
     CrossDeviceServerManager,

@@ -2,10 +2,11 @@
 
 FedML Parrot (arXiv:2303.01778) and FedJAX (arXiv:2108.02117) both
 locate planet-scale simulation in the same design move: client state is
-*data*, not objects. A registered client here is one row across four
-columns — dataset size, speed tier, data-shard offset, per-client seed
-— about 17 bytes, so a 1M-client registry is ~17 MB of NumPy (or
-disk-backed memmap) instead of a million Python dataset objects.
+*data*, not objects. A registered client here is one row across six
+columns — dataset size, speed tier, data-shard offset, per-client seed,
+diurnal availability phase, last check-in round — about 22 bytes, so a
+1M-client registry is ~22 MB of NumPy (or disk-backed memmap) instead
+of a million Python dataset objects.
 
 Everything per-round is O(cohort):
 
@@ -37,13 +38,19 @@ import numpy as np
 __all__ = ["ClientRegistry"]
 
 # column name -> dtype; the registry's entire per-client schema. One
-# row is 4 + 1 + 8 + 4 = 17 bytes.
+# row is 4 + 1 + 8 + 4 + 1 + 4 = 22 bytes.
 _COLUMNS = (
     ("num_samples", np.int32),
     ("speed_tier", np.int8),
     ("shard_offset", np.int64),
     ("client_seed", np.uint32),
+    ("availability", np.uint8),
+    ("last_checkin", np.int32),
 )
+
+# columns that are mutated at run time (memmaps reopen writable);
+# everything else is generated once and reopened read-only
+_MUTABLE_COLUMNS = frozenset({"last_checkin"})
 
 
 class ClientRegistry:
@@ -55,9 +62,14 @@ class ClientRegistry:
     are clipped into this range (the ``synthetic_fedprox`` convention —
     a heavy-tailed, heterogeneous population). ``speed_tiers``: number
     of device-speed classes; tier ``t`` is modeled as ``2**t`` x slower
-    per sample by the cohort packer's LPT balancing.
+    per sample by the cohort packer's LPT balancing. ``duty_hours``:
+    hours per day a device is reachable — each device's ``availability``
+    column is a seeded diurnal phase (the hour its on-window opens), so
+    availability is a deterministic on/off trace per device, never a
+    coin flip per query.
     ``memmap_dir``: when given, columns live in ``<dir>/<name>.npy``
-    memmaps (written once, reopened read-only) so even the O(N) column
+    memmaps (written once, reopened read-only — except the mutable
+    ``last_checkin`` column, reopened writable) so even the O(N) column
     footprint leaves host RAM.
     """
 
@@ -68,6 +80,7 @@ class ClientRegistry:
         min_samples: int = 20,
         max_samples: int = 400,
         speed_tiers: int = 3,
+        duty_hours: int = 14,
         memmap_dir: Optional[str] = None,
     ) -> None:
         if size < 1:
@@ -78,11 +91,16 @@ class ClientRegistry:
             )
         if speed_tiers < 1:
             raise ValueError(f"speed_tiers={speed_tiers}: must be >= 1")
+        if not 1 <= duty_hours <= 24:
+            raise ValueError(
+                f"duty_hours={duty_hours}: must be in [1, 24]"
+            )
         self.size = int(size)
         self.seed = int(seed)
         self.min_samples = int(min_samples)
         self.max_samples = int(max_samples)
         self.speed_tiers = int(speed_tiers)
+        self.duty_hours = int(duty_hours)
         cols = self._generate_columns()
         if memmap_dir is not None:
             cols = self._to_memmap(cols, memmap_dir)
@@ -90,6 +108,8 @@ class ClientRegistry:
         self.speed_tier: np.ndarray = cols["speed_tier"]
         self.shard_offset: np.ndarray = cols["shard_offset"]
         self.client_seed: np.ndarray = cols["client_seed"]
+        self.availability: np.ndarray = cols["availability"]
+        self.last_checkin: np.ndarray = cols["last_checkin"]
         self.total_samples = int(
             self.shard_offset[-1] + self.num_samples[-1]
         )
@@ -111,6 +131,11 @@ class ClientRegistry:
         cseed = rng.randint(
             0, 2**31 - 1, size=self.size, dtype=np.int64
         ).astype(np.uint32)
+        # diurnal phase draw comes AFTER the original column draws so
+        # the pre-availability columns stay bit-identical for a given
+        # seed (the determinism contract is per (seed, size), ratcheted
+        # — never reshuffled by a new column)
+        phase = rng.randint(0, 24, size=self.size).astype(np.uint8)
         # prefix-sum offsets: client i's samples live at
         # [offset[i], offset[i] + num_samples[i]) of a contiguous shard
         off = np.zeros(self.size, dtype=np.int64)
@@ -120,6 +145,9 @@ class ClientRegistry:
             "speed_tier": tier,
             "shard_offset": off,
             "client_seed": cseed,
+            "availability": phase,
+            # -1 = never checked in; the check-in plane stamps rounds
+            "last_checkin": np.full(self.size, -1, dtype=np.int32),
         }
 
     @staticmethod
@@ -136,11 +164,12 @@ class ClientRegistry:
             mm[:] = cols[name]
             mm.flush()
             del mm
-            out[name] = np.load(path, mmap_mode="r")
+            mode = "r+" if name in _MUTABLE_COLUMNS else "r"
+            out[name] = np.load(path, mmap_mode=mode)
         return out
 
     def nbytes(self) -> int:
-        """Registry column footprint in bytes (~17 per client)."""
+        """Registry column footprint in bytes (~22 per client)."""
         return int(
             sum(
                 getattr(self, name).dtype.itemsize
@@ -172,6 +201,69 @@ class ClientRegistry:
             t = int(rs.randint(0, j + 1))
             chosen.add(t if t not in chosen else j)
         return np.fromiter(sorted(chosen), dtype=np.int64, count=k)
+
+    # -- availability (diurnal on/off process) ------------------------
+    def is_available(self, index, hour: int) -> np.ndarray:
+        """Whether device(s) ``index`` are reachable at ``hour``
+        (0-23). A device's on-window opens at its seeded diurnal phase
+        and lasts ``duty_hours`` — a deterministic per-device trace, so
+        the same (registry, hour) always yields the same on/off set."""
+        ph = self.availability[index].astype(np.int64)
+        return ((int(hour) - ph) % 24) < self.duty_hours
+
+    def sample_available_cohort(
+        self,
+        round_idx: int,
+        cohort_size: int,
+        hour: Optional[int] = None,
+        max_draw_factor: int = 64,
+    ) -> np.ndarray:
+        """Deterministic cohort restricted to currently-available
+        devices — the Beehive sampler (docs/cross_device.md).
+
+        Rejection sampling over single draws: candidates are drawn one
+        at a time from the full registry and kept only when available
+        at ``hour`` (default ``round_idx % 24``) and not already
+        chosen, so peak memory stays O(cohort) — no availability mask
+        over all N is ever built. Draw attempts are capped at
+        ``max_draw_factor * cohort_size``; exhausting the cap (duty
+        cycle too low for the requested cohort) raises a named error
+        instead of looping forever."""
+        k = int(cohort_size)
+        n = self.size
+        if not 1 <= k <= n:
+            raise ValueError(
+                f"cohort_size={k} out of range for registry size {n}"
+            )
+        h = int(round_idx) % 24 if hour is None else int(hour) % 24
+        # a distinct stream from sample_cohort's: availability-aware
+        # draws must not correlate with the unconditional sampler
+        rs = np.random.RandomState(
+            (self.seed * 1_000_003 + int(round_idx) * 2 + 1) % (2**32)
+        )
+        chosen: set = set()
+        attempts = 0
+        cap = max_draw_factor * k
+        while len(chosen) < k:
+            if attempts >= cap:
+                raise ValueError(
+                    f"sample_available_cohort: {attempts} draws found "
+                    f"only {len(chosen)}/{k} available devices at "
+                    f"hour={h} (duty_hours={self.duty_hours}); lower "
+                    "the cohort or raise the duty cycle"
+                )
+            t = int(rs.randint(0, n))
+            attempts += 1
+            if t in chosen:
+                continue
+            if bool(self.is_available(t, h)):
+                chosen.add(t)
+        return np.fromiter(sorted(chosen), dtype=np.int64, count=k)
+
+    def record_checkin(self, index, round_idx: int) -> None:
+        """Stamp ``last_checkin`` for device(s) ``index`` — the only
+        mutable column (writable memmap when disk-backed)."""
+        self.last_checkin[index] = np.int32(round_idx)
 
     # -- O(cohort) materialization ------------------------------------
     def shard_slice(self, index: int) -> Tuple[int, int]:

@@ -222,6 +222,12 @@ GROUPS = [
     ("Hierarchical server plane (edge aggregators as ranks)", [
         "edge_num", "edge_plane", "hier_port_stride",
     ]),
+    ("Cross-device Beehive plane (connectionless check-in)", [
+        "crossdevice_cohort", "crossdevice_fold_target_frac",
+        "crossdevice_report_window_s", "crossdevice_secure_agg",
+        "crossdevice_quant_scale", "crossdevice_mask_threshold",
+        "crossdevice_duty_hours", "crossdevice_verify_pubkey",
+    ]),
     ("Validation & tracking", [
         "frequency_of_the_test", "enable_tracking", "run_id", "profile_dir",
         "telemetry", "telemetry_dir", "stall_timeout_s", "trace_ring_size",

@@ -118,6 +118,9 @@ PHASES = [
     ("chaosplan", ["--phase", "chaosplan"], 480.0),
     ("planet", ["--phase", "planet"], 480.0),
     ("hier", ["--phase", "hier"], 480.0),
+    # Beehive check-in plane: 100k registry, churned cohorts, masked
+    # vs unmasked twin worlds + dropout recovery + fedml-tpu check
+    ("crossdevice", ["--phase", "crossdevice"], 480.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

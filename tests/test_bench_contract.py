@@ -191,6 +191,19 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"hier"' in parent or "'hier'" in parent
 
+    def test_crossdevice_phase_contract(self):
+        """detail.crossdevice ships the Beehive plane evidence (rounds
+        closing on fold targets under 30% churn, masked fold bitwise
+        identical to unmasked, ledger == counters, one trace per
+        (tier, bucket), invariants + `fedml-tpu check` green): the
+        phase is in the child vocabulary and the parent stitches it
+        (like hier, it runs demoted on the CPU fallback)."""
+        assert "crossdevice" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"crossdevice"' in parent or "'crossdevice'" in parent
+
 
 class TestPhaseChild:
     def _run_child(self, phase: str, timeout: int, smoke: bool = False) -> dict:
@@ -569,6 +582,34 @@ class TestPhaseChild:
         assert d["rounds_per_sec"] > 0
         # a --cpu mesh JSON must never read as a TPU number
         assert d["cpu_fallback"] is True
+
+    @pytest.mark.slow  # ~10s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's crossdevice smoke block
+    def test_crossdevice_smoke_child_writes_valid_json(self):
+        """The CI crossdevice smoke invocation (100k registry, cohort
+        64, 3 rounds, 30% scheduled mid-round vanish, CPU): the Beehive
+        check-in plane runs end-to-end through bench.py's crossdevice
+        phase child and emits the detail.crossdevice contract keys —
+        every round closes on its fold target despite churn, the
+        pairwise-masked fold is bitwise identical to the unmasked twin
+        world (dropout recovery included), the WAL fold ledger matches
+        the telemetry counters, exactly one jit trace per (speed tier,
+        pow2 bucket), and the invariant checker plus `fedml-tpu check`
+        stay green on the artifacts."""
+        d = self._run_child("crossdevice", 500, smoke=True)
+        assert d["registry_size"] == 100_000
+        assert d["rounds"] == 3
+        assert d["closes_on_target"] is True
+        assert d["folds_per_s"] > 0
+        assert d["mask_recoveries"] > 0
+        assert d["masked_vs_unmasked_max_abs_diff"] == 0.0
+        assert d["ledger_matches_counters"] is True
+        assert d["one_trace_per_shape"] is True
+        assert d["trace_count"] == len(d["shape_keys"])
+        assert d["invariants_ok"] is True
+        assert d["check_rc"] == 0
+        assert d["counters"]["device_mask_recovery_failures_total"] == 0
+        assert d["ok"] is True
 
 
 class TestCaptureSidecar:

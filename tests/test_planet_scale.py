@@ -69,8 +69,10 @@ class TestClientRegistry:
             assert np.array_equal(getattr(r1, col), getattr(r2, col)), col
         r3 = ClientRegistry(5000, seed=4)
         assert not np.array_equal(r1.num_samples, r3.num_samples)
-        # ~17 bytes per client, no hidden python-object population
-        assert r1.nbytes() == 17 * 5000
+        # ~22 bytes per client (incl. the cross-device availability
+        # phase + last_checkin columns), no hidden python-object
+        # population
+        assert r1.nbytes() == 22 * 5000
         assert (r1.num_samples >= 20).all() and (r1.num_samples <= 400).all()
 
     def test_shard_offsets_are_prefix_sums(self):
@@ -125,12 +127,23 @@ class TestClientRegistry:
     def test_memmap_registry_matches_in_ram(self, tmp_path):
         rram = ClientRegistry(1_000, seed=9)
         rmm = ClientRegistry(1_000, seed=9, memmap_dir=str(tmp_path))
-        for col in ("num_samples", "speed_tier", "shard_offset", "client_seed"):
+        for col in (
+            "num_samples", "speed_tier", "shard_offset", "client_seed",
+            "availability", "last_checkin",
+        ):
             assert np.array_equal(getattr(rram, col), getattr(rmm, col)), col
         assert os.path.exists(tmp_path / "num_samples.npy")
+        assert os.path.exists(tmp_path / "availability.npy")
         assert np.array_equal(
             rram.sample_cohort(3, 64), rmm.sample_cohort(3, 64)
         )
+        # last_checkin is the one run-time-mutable column: stamps made
+        # through the memmap registry round-trip to disk and back
+        avail = rmm.sample_available_cohort(0, 8)
+        rmm.record_checkin(int(avail[0]), 4)
+        reopened = np.load(tmp_path / "last_checkin.npy", mmap_mode="r")
+        assert int(reopened[int(avail[0])]) == 4
+        assert int(rram.last_checkin[int(avail[0])]) == -1  # RAM twin untouched
 
     def test_validation(self):
         with pytest.raises(ValueError):
@@ -409,13 +422,13 @@ class TestRegistrySimulation:
 
     @pytest.mark.slow  # 1M-registry columns + one materialized round
     def test_1m_registry_round_memory_is_o_cohort(self):
-        """A 1M-client registry round: columns cost ~17 MB and the
+        """A 1M-client registry round: columns cost ~22 MB and the
         sample->pack->materialize path for a 1k cohort stays under a
         cohort-scale RSS bound (nothing O(registry) materializes)."""
         from fedml_tpu.core.sys_stats import current_rss_bytes
 
         reg = ClientRegistry(1_000_000, seed=0)
-        assert reg.nbytes() == 17_000_000
+        assert reg.nbytes() == 22_000_000
         idx = reg.sample_cohort(0, 1000)
         plan = pack_cohort(
             reg.num_samples[idx], idx, 32, speed_tier=reg.speed_tier[idx]
@@ -503,3 +516,61 @@ class TestRegistrySimulation:
                     poison_type="label_flip", poisoned_client_idxs=[0],
                 )
             )
+
+
+class TestAvailability:
+    """The diurnal availability plane the Beehive sampler draws from
+    (docs/cross_device.md)."""
+
+    def test_availability_is_deterministic_diurnal_trace(self):
+        r1 = ClientRegistry(5_000, seed=3)
+        r2 = ClientRegistry(5_000, seed=3)
+        assert np.array_equal(r1.availability, r2.availability)
+        idx = np.arange(5_000)
+        for hour in (0, 7, 23):
+            a = r1.is_available(idx, hour)
+            assert np.array_equal(a, r2.is_available(idx, hour))
+            # duty_hours=14 of 24: roughly that fraction is on at any hour
+            frac = float(a.mean())
+            assert 0.5 < frac < 0.68, frac
+        # a device is on for exactly duty_hours of the day
+        on_hours = sum(
+            int(r1.is_available(17, h)) for h in range(24)
+        )
+        assert on_hours == r1.duty_hours
+
+    def test_available_cohort_deterministic_and_actually_available(self):
+        reg = ClientRegistry(10_000, seed=1)
+        a = reg.sample_available_cohort(5, 256)
+        assert np.array_equal(a, reg.sample_available_cohort(5, 256))
+        assert len(np.unique(a)) == 256
+        assert bool(reg.is_available(a, 5 % 24).all())
+        # a different round is a different hour AND a different stream
+        b = reg.sample_available_cohort(6, 256)
+        assert not np.array_equal(a, b)
+        # the availability-aware stream must not mirror the plain one
+        assert not np.array_equal(a, reg.sample_cohort(5, 256))
+
+    def test_available_sampling_memory_is_o_cohort_on_1m_registry(self):
+        reg = ClientRegistry(1_000_000, seed=0)
+        reg.sample_available_cohort(0, 1000)  # warm lazy allocations
+        tracemalloc.start()
+        reg.sample_available_cohort(1, 1000)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # no availability mask over all N is ever built (~1 MB);
+        # the bound is the same two-decades-under as sample_cohort's
+        assert peak < 512 * 1024, f"available sampling peak {peak} bytes"
+
+    def test_low_duty_cycle_raises_named_error(self):
+        reg = ClientRegistry(64, seed=0, duty_hours=1)
+        with pytest.raises(ValueError, match="sample_available_cohort"):
+            reg.sample_available_cohort(0, 60, max_draw_factor=2)
+
+    def test_checkin_stamps_only_named_devices(self):
+        reg = ClientRegistry(100, seed=0)
+        assert (reg.last_checkin == -1).all()
+        reg.record_checkin(np.asarray([3, 7]), 12)
+        assert int(reg.last_checkin[3]) == 12
+        assert int(reg.last_checkin[7]) == 12
+        assert (np.delete(reg.last_checkin, [3, 7]) == -1).all()
