@@ -98,17 +98,11 @@ def _capture_dir() -> str:
 # a drift test like _CAPTURE_BASENAME / PHASE_CHOICES).
 _STOP_BASENAME = ".tpu_watch_stop"
 
-# bf16 peak matmul TFLOP/s by device kind (public spec sheets); used
-# only to contextualize achieved FLOP/s as a rough MFU. Unknown kinds
-# report achieved FLOP/s without an MFU.
-_PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
+# bf16 peak matmul TFLOP/s lives in fedml_tpu.constants
+# (PEAK_BF16_TFLOPS) so every MFU denominator — bench, `fedml-tpu
+# perf`, the watch loop, the capture analyzer — is the same number.
+# Imported lazily: the parent driver must not pull in fedml_tpu (and
+# with it jax) before the child's env vars are decided.
 
 
 def _emit(payload: dict) -> None:
@@ -352,6 +346,82 @@ def _aggregation_exchange(model, n_iter: int = 20) -> dict:
     }
 
 
+# headline-metric priority for the ratchet's value extraction: phases
+# without a top-level {value, unit} headline expose one of these
+_META_METRIC_KEYS = (
+    "rounds_per_sec",
+    "samples_per_sec",
+    "requests_per_sec",
+    "tokens_per_sec",
+)
+
+
+def _meta_headline(out: dict):
+    """(value, metric, unit) the ratchet compares for this phase record.
+    Deterministic per phase shape: explicit {value, unit} headline
+    first, then the known throughput keys, then the first top-level
+    numeric by sorted key."""
+    v = out.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v), str(out.get("metric", "value")), str(out.get("unit", ""))
+    for k in _META_METRIC_KEYS:
+        v = out.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v), k, k
+    for k in sorted(out):
+        v = out[k]
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v), k, k
+    return None, None, None
+
+
+def _find_mfu(node):
+    """First ``mfu_vs_bf16_peak`` anywhere in the record (the dense /
+    headline detail blocks carry it when the device kind is known)."""
+    if isinstance(node, dict):
+        v = node.get("mfu_vs_bf16_peak")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        for val in node.values():
+            found = _find_mfu(val)
+            if found is not None:
+                return found
+    elif isinstance(node, list):
+        for val in node:
+            found = _find_mfu(val)
+            if found is not None:
+                return found
+    return None
+
+
+def _bench_meta(phase: str, smoke: bool, out: dict) -> dict:
+    """The mandatory meta block every bench record carries (perf-plane
+    ratchet contract, tests/test_bench_contract.py): device_kind /
+    backend / smoke label the record so `fedml-tpu perf --ratchet`
+    groups CPU smoke records separately from TPU captures; value /
+    metric / unit carry the phase headline it compares; mfu rides along
+    where the phase computed one."""
+    import jax
+
+    from fedml_tpu.constants import normalize_device_kind
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    meta = {
+        "schema": 1,
+        "phase": str(phase),
+        "device_kind": normalize_device_kind(kind),
+        "backend": jax.default_backend(),
+        "smoke": bool(smoke),
+    }
+    value, metric, unit = _meta_headline(out)
+    if value is not None:
+        meta.update(value=value, metric=metric, unit=unit)
+    mfu = _find_mfu(out)
+    if mfu is not None:
+        meta["mfu"] = mfu
+    return meta
+
+
 def _mfu_detail(flops: float, rps: float, n_chips: int = 1) -> dict:
     """Achieved FLOP/s (+ MFU when the device kind's peak is known).
 
@@ -360,16 +430,15 @@ def _mfu_detail(flops: float, rps: float, n_chips: int = 1) -> dict:
     """
     import jax
 
+    from fedml_tpu.constants import peak_bf16_flops
+
     out = {
         "model_flops_per_sec": round(flops * rps, 1),
         "flops_source": "xla_cost_analysis (static estimate)",
     }
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    # longest-match so e.g. a hypothetical "TPU v4i" never matches
-    # the "TPU v4" entry's peak
-    matches = [(len(k), v) for k, v in _PEAK_TFLOPS.items() if k.lower() in kind]
-    if matches:
-        peak = max(matches)[1] * 1e12
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = peak_bf16_flops(kind)
+    if peak > 0:
         out["mfu_vs_bf16_peak"] = round(flops * rps / (peak * n_chips), 4)
         out["peak_assumed_tflops"] = peak / 1e12
     return out
@@ -3121,6 +3190,50 @@ def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
         summary = trace_run(tdir)  # shards of the LAST traced world
         with open(summary["round_report"]) as fh:
             report = json.load(fh)
+        # perf-plane readout (analysis/perf) over the same traced world:
+        # the idle ledger + roofline join `fedml-tpu perf` computes,
+        # folded into the phase record so the watcher's MFU/idle column
+        # reads live series instead of re-deriving them
+        try:
+            from fedml_tpu.analysis import perf as _perf
+
+            _measured = _perf.exec_seconds_from_snapshots(
+                _perf.load_snapshots(tdir)
+            )
+            _ledger = _perf.summarize_ledger(_perf.load_ledgers(tdir))
+            _roof = _perf.join_roofline(
+                _perf.load_audit_report(
+                    os.path.join(_capture_dir(), _perf.AUDIT_REPORT_NAME)
+                ),
+                _measured,
+                device_kind=jax.devices()[0].device_kind,
+            )
+            _top = max(
+                (r for r in _roof["rows"] if r.get("mfu_vs_bf16_peak")),
+                key=lambda r: r["mfu_vs_bf16_peak"],
+                default=None,
+            )
+            _recons = [
+                r["recon_frac"]
+                for r in _ledger["rounds"]
+                if r.get("recon_frac") is not None
+            ]
+            perf_plane = {
+                "exec_series": len(_measured),
+                "coverage": _roof["coverage"],
+                "top_mfu_executable": _top["executable"] if _top else None,
+                "mfu_vs_bf16_peak": (
+                    _top["mfu_vs_bf16_peak"] if _top else None
+                ),
+                "ledger_rounds": len(_ledger["rounds"]),
+                "min_recon_frac": min(_recons) if _recons else None,
+                "idle_totals_s": _ledger["idle_totals_s"],
+                "mean_wire_utilization_frac": _ledger[
+                    "mean_wire_utilization_frac"
+                ],
+            }
+        except Exception as e:  # noqa: BLE001 — readout must not kill the phase
+            perf_plane = {"error": f"{type(e).__name__}: {e}"}
     finally:
         _shutil.rmtree(tdir, ignore_errors=True)
 
@@ -3210,6 +3323,7 @@ def run_tracing(on_cpu: bool, smoke: bool = False) -> dict:
             "straggler_ranks": [
                 r["straggler_rank"] for r in report["rounds"]
             ],
+            "perf_plane": perf_plane,
         }
     )
     _progress(
@@ -4015,6 +4129,11 @@ def _phase_main(argv) -> None:
         out = run_crossdevice(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
+    if isinstance(out, dict):
+        # the meta block is attached HERE, once, so every producer —
+        # round-end driver, watcher capture, CI smoke child — emits the
+        # ratchet contract without per-phase plumbing
+        out.setdefault("meta", _bench_meta(a.phase, a.smoke, out))
     with open(a.out, "w") as fh:
         json.dump(out, fh)
 

@@ -60,6 +60,14 @@ _HISTOGRAM_SUFFIXES = (
 
 _EMIT_METHODS = {"inc": "counter", "set_gauge": "gauge", "observe": "histogram"}
 
+# unit-suffix near-misses: abbreviations and synonyms of the canonical
+# vocabulary that read fine in review but split dashboards into two
+# series families ("wire_utilization_fraction" next to "_frac")
+_UNIT_NEAR_MISSES = (
+    "_sec", "_secs", "_second", "_millis", "_msec", "_fraction",
+    "_percent", "_pct", "_byte", "_count",
+)
+
 # Arguments methods — `args.get(...)` et al. are API calls, not knob
 # attribute reads (the .get STRING key is collected separately)
 _ARGS_METHODS = {
@@ -308,6 +316,18 @@ def check_registry(
                 message=(
                     f"gauge '{name}' ends in _total — Prometheus "
                     "reserves _total for counters; rename the gauge"
+                ),
+            ))
+        elif kind in ("gauge", "histogram") and name.endswith(
+            _UNIT_NEAR_MISSES
+        ):
+            findings.append(Finding(
+                path=path, line=line, rule=RULE,
+                message=(
+                    f"{kind} '{name}' ends in a unit-suffix near-miss "
+                    "— use the canonical vocabulary "
+                    "(_seconds/_s/_ms/_bytes/_frac/_ratio/_rounds) so "
+                    "one quantity stays one series family"
                 ),
             ))
         elif kind == "histogram" and not name.endswith(_HISTOGRAM_SUFFIXES):

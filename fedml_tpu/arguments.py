@@ -290,6 +290,10 @@ _DEFAULTS: Dict[str, Any] = {
     # counted in telemetry_trace_dropped_total and the exported trace's
     # meta — a run that outgrows the ring is visible, not silent
     "trace_ring_size": 65536,
+    # devtime wall-clock ring capacity (core/devtime.py): per-dispatch
+    # {executable, bucket, seconds} entries kept for the perf plane's
+    # fallback join when histogram snapshots are unavailable
+    "devtime_ring_size": 4096,
     # on-demand device profiling (core/tracing.py RoundProfiler): round
     # indices (list or "1,5,9" string) to capture a programmatic
     # jax.profiler trace for, into telemetry_dir/profile/round_NNNN.
@@ -411,6 +415,7 @@ _DEFAULTS: Dict[str, Any] = {
     "gan_lr_d": 0.0002,  # FedGAN discriminator LR
     "splitnn_stages": (1, 1, 1),  # SplitNN (client, server, head) depths
     "vfl_parties": 2,  # vertical-FL feature-holding parties
+    "vfl_rep_dim": 32,  # vertical-FL per-party representation width
     "gkt_server_stages": (2, 2, 2),  # FedGKT server tower depths
     "gkt_alpha": 1.0,  # FedGKT distillation loss weight
     "gkt_temperature": 3.0,  # FedGKT softmax temperature
@@ -427,6 +432,9 @@ _DEFAULTS: Dict[str, Any] = {
     "sampling_filter": "exp",  # S-FedAvg score->probability filter
     "score_method": "acc",  # S-FedAvg client scoring signal
     "sv_tol": 0.005,  # Shapley truncation tolerance
+    # Shapley permutation cap; None = auto (client_num_per_round ** 2,
+    # the reference's cohort**2 distance-sample cap)
+    "sv_max_perms": None,
     "valid_batches": 4,  # validation batches for defense scoring
     "hs_L": 0.0,  # HS-FedAvg FFT band (0 = derive from the input)
     "hs_momentum": 0.1,  # HS-FedAvg spectral-mask momentum
@@ -799,11 +807,15 @@ class Arguments:
                 raise ValueError(
                     f"checkpoint_freq={self.checkpoint_freq}: must be >= 1"
                 )
-        for int_key in ("trace_ring_size", "metrics_port"):
+        for int_key in ("trace_ring_size", "devtime_ring_size", "metrics_port"):
             setattr(self, int_key, int(getattr(self, int_key)))
         if self.trace_ring_size < 1:
             raise ValueError(
                 f"trace_ring_size={self.trace_ring_size}: must be >= 1"
+            )
+        if self.devtime_ring_size < 1:
+            raise ValueError(
+                f"devtime_ring_size={self.devtime_ring_size}: must be >= 1"
             )
         if not 0 <= self.metrics_port <= 65535:
             raise ValueError(

@@ -434,6 +434,18 @@ def cmd_audit(args) -> int:
     return run_cli(args)
 
 
+def cmd_perf(args) -> int:
+    """Performance-attribution plane (docs/observability.md): join a
+    run's measured ``exec_device_seconds`` onto the audit roofline
+    (per-executable achieved FLOP/s + MFU + bound verdict), summarize
+    the per-round idle-time ledger, or — with ``--ratchet`` — gate the
+    BENCH trajectory against its best prior record per phase and
+    device kind. Pure stdlib, like `lint`: runs on a bare checkout."""
+    from .analysis.perf import run_cli
+
+    return run_cli(args)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fedml-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -523,6 +535,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_audit_arguments(audit)
     audit.set_defaults(fn=cmd_audit)
+
+    perf = sub.add_parser("perf")
+    from .analysis.perf import add_perf_arguments
+
+    add_perf_arguments(perf)
+    perf.set_defaults(fn=cmd_perf)
 
     build = sub.add_parser("build")
     build.add_argument("-t", "--type", required=True, choices=["client", "server"])

@@ -220,3 +220,69 @@ DEVICE_WINDOW_REPORT = "report"
 # expired — never cohort completeness)
 DEVICE_CLOSE_TARGET = "target"
 DEVICE_CLOSE_WINDOW = "window"
+
+# -- performance-attribution plane (analysis/perf.py, bench.py, ------
+# scripts/tpu_watch.py, scripts/analyze_capture.py) -------------------
+# bf16 peak matmul TFLOP/s per chip by device kind (public spec
+# sheets). THE one table every MFU denominator comes from: bench
+# detail.mfu_vs_bf16_peak, `fedml-tpu perf`'s roofline join, the watch
+# loop's live MFU column and the capture analyzer all route through
+# peak_bf16_flops() so no two tools can disagree about a device's
+# peak. Unknown kinds report achieved FLOP/s without an MFU.
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+# approximate per-chip HBM bandwidth (TB/s, same spec sheets): the
+# roofline ridge point peak_flops/bandwidth decides compute- vs
+# memory-bound verdicts in `fedml-tpu perf`
+HBM_BANDWIDTH_TBPS = {
+    "TPU v4": 1.2,
+    "TPU v5 lite": 0.82,
+    "TPU v5e": 0.82,
+    "TPU v5p": 2.77,
+    "TPU v6 lite": 1.64,
+    "TPU v6e": 1.64,
+}
+
+
+def normalize_device_kind(kind: str) -> str:
+    """Canonical device-kind label for bench meta / ratchet grouping:
+    strips per-chip ordinals jax appends (``"TPU v5 lite0"`` ->
+    ``"TPU v5 lite"``) and folds every CPU spelling (``TFRT_CPU_0``,
+    ``cpu``, ``Cpu0``) to ``"cpu"`` so smoke records always group
+    together and never ratchet against TPU captures."""
+    k = str(kind or "").strip()
+    if "cpu" in k.lower():
+        return "cpu"
+    # longest-match against the known table so "TPU v4i" never folds
+    # into "TPU v4"; per-chip ordinal suffixes (digits) are tolerated
+    best = ""
+    low = k.lower()
+    for name in PEAK_BF16_TFLOPS:
+        nl = name.lower()
+        if (low == nl or low.startswith(nl)) and len(name) > len(best):
+            rest = low[len(nl):]
+            if rest == "" or rest.isdigit():
+                best = name
+    return best or k
+
+
+def peak_bf16_flops(kind: str) -> float:
+    """Per-chip bf16 peak in FLOP/s for ``kind`` (device_kind string,
+    ordinal suffix OK), or 0.0 when unknown — callers treat 0 as
+    "report achieved FLOP/s without an MFU"."""
+    canon = normalize_device_kind(kind)
+    peak = PEAK_BF16_TFLOPS.get(canon, 0.0)
+    return peak * 1e12
+
+
+def hbm_bandwidth_bytes(kind: str) -> float:
+    """Per-chip HBM bandwidth in bytes/s, or 0.0 when unknown."""
+    canon = normalize_device_kind(kind)
+    return HBM_BANDWIDTH_TBPS.get(canon, 0.0) * 1e12

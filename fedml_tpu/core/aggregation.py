@@ -27,6 +27,7 @@ import numpy as np
 
 from .. import constants
 from ..analysis.compiled import auditable
+from .devtime import measure as _devtime
 
 Params = Any  # pytree of jax.Array
 
@@ -436,7 +437,9 @@ class StreamingAccumulator:
         self.reset()
 
     def fold(self, theta: Params, w: float) -> None:
-        self._fold_term(_weighted_term(theta, jnp.float32(w)), w)
+        with _devtime("agg.weighted_term"):
+            term = _weighted_term(theta, jnp.float32(w))
+        self._fold_term(term, w)
 
     def fold_weighted_term(self, term: Params, w: float) -> None:
         """Fold an ALREADY-WEIGHTED partial sum ``term = sum_i w_i *
@@ -475,9 +478,10 @@ class StreamingAccumulator:
     def fold_clipped(
         self, theta: Params, against: Params, bound: float, w: float
     ) -> Tuple[float, bool]:
-        term, norm, clipped = _weighted_term_clipped(
-            theta, against, jnp.float32(bound), jnp.float32(w)
-        )
+        with _devtime("agg.weighted_term_clipped"):
+            term, norm, clipped = _weighted_term_clipped(
+                theta, against, jnp.float32(bound), jnp.float32(w)
+            )
         self._fold_term(term, w)
         # the screen needs (norm, clipped?) on host per upload: one
         # deliberate fetch, counted by the caller
@@ -497,9 +501,10 @@ class StreamingAccumulator:
     def fold_delta_clipped(
         self, delta: Params, bound: float, w: float
     ) -> Tuple[float, bool]:
-        term, norm, clipped = _weighted_delta_term_clipped(
-            delta, jnp.float32(bound), jnp.float32(w)
-        )
+        with _devtime("agg.weighted_delta_term_clipped"):
+            term, norm, clipped = _weighted_delta_term_clipped(
+                delta, jnp.float32(bound), jnp.float32(w)
+            )
         self._fold_term(term, w)
         # the screen needs (norm, clipped?) on host per upload: one
         # deliberate fetch, counted by the caller
@@ -578,7 +583,8 @@ class StreamingAccumulator:
                 f"count={count}: a limb-set represents >= 0 uploads"
             )
         for limb in limbs:
-            self._limbs = _fold_tree(self._limbs, limb)
+            with _devtime("agg.fold_tree"):
+                self._limbs = _fold_tree(self._limbs, limb)
         self.total_w += float(w)  # lint: host-sync-ok — host scalar bookkeeping
         self.count += int(count)  # lint: host-sync-ok — host int bookkeeping
 
@@ -596,7 +602,8 @@ class StreamingAccumulator:
         self.fold_limbs(other._limbs, other.total_w, count=other.count)
 
     def _fold_term(self, term: Params, w: float) -> None:
-        self._limbs = _fold_tree(self._limbs, term)
+        with _devtime("agg.fold_tree"):
+            self._limbs = _fold_tree(self._limbs, term)
         # float32 first (the term used fl32(w)); python-float sums of
         # integer sample counts are exact in any order
         self.total_w += float(jnp.float32(w))  # lint: host-sync-ok — w is a host scalar; fl32 rounding only

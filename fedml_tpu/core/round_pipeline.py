@@ -53,6 +53,7 @@ import numpy as np
 # plane's micro-batcher); re-exported here for compat — both names were
 # part of this module's public surface before the factor-out
 from .bucketing import bucket_cohort, pad_cohort_idx  # noqa: F401
+from .devtime import measure as _devtime
 from .tracking import DeferredMetrics
 
 __all__ = ["RoundPipeline", "bucket_cohort", "pad_cohort_idx"]
@@ -226,16 +227,17 @@ class RoundPipeline:
             lr_mult = lr_plan[i]
             extra = () if lr_mult is None else (lr_mult,)
             with api.profiler.span("round"):
-                out = api._round_fn(
-                    api.global_params,
-                    api.server_state,
-                    packed,
-                    nsamples,
-                    idx_dev,
-                    key_plan[i],
-                    *extra,
-                    valid=valid_dev,
-                )
+                with _devtime(api._round_exec_name(), bucket=f"b{bucket}"):
+                    out = api._round_fn(
+                        api.global_params,
+                        api.server_state,
+                        packed,
+                        nsamples,
+                        idx_dev,
+                        key_plan[i],
+                        *extra,
+                        valid=valid_dev,
+                    )
             api.global_params, api.server_state, summed = out[:3]
             api.rng = head_plan[i]
             # back-pressure: bound in-flight rounds at K with a wait

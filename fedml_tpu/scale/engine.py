@@ -33,6 +33,7 @@ import numpy as np
 
 from ..analysis.compiled import auditable, pow2_budget
 from ..core.aggregation import StreamingAccumulator
+from ..core.devtime import measure as _devtime
 from .cohort import pack_cohort
 from .registry import ClientRegistry
 from .tree import EdgeAggregationTree
@@ -391,15 +392,18 @@ class PlanetRoundLoop:
                 # mod E), not of its slot — stable across cohorts
                 onehot = np.zeros((group.bucket, E), dtype=np.float32)
                 onehot[np.arange(group.bucket), group.client_idx % E] = 1.0
-                gp, terms, edge_w, m = self._group_fn(
-                    gp,
-                    batches,
-                    jnp.asarray(group.num_samples),
-                    jnp.asarray(group.valid),
-                    jnp.asarray(onehot),
-                    jax.random.fold_in(round_rng, g_i),
-                    *extra,
-                )
+                with _devtime(
+                    "planet.group_fn", bucket=f"b{group.bucket}xnb{group.nb}"
+                ):
+                    gp, terms, edge_w, m = self._group_fn(
+                        gp,
+                        batches,
+                        jnp.asarray(group.num_samples),
+                        jnp.asarray(group.valid),
+                        jnp.asarray(onehot),
+                        jax.random.fold_in(round_rng, g_i),
+                        *extra,
+                    )
                 # deliberate O(E)-scalar fetch: the per-edge fold
                 # weights drive host-side python fold bookkeeping
                 # (StreamingAccumulator.total_w is an exact python-

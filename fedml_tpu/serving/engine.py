@@ -32,6 +32,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ..core.devtime import measure as _devtime
 from .admission import AdmissionController, ServingShedError
 from .batcher import STOP, MicroBatcher
 from .endpoint import ModelEndpoint
@@ -296,8 +297,11 @@ class ServingEngine:
         if tel.enabled:
             rec.begin("serve.batch", cat="serving", bucket=bucket, n=n)
         try:
-            y = self.endpoint.infer(padded)
-            host = np.asarray(y)  # ONE fetch per micro-batch
+            # dispatch + the single fetch inside one measure: unlike the
+            # async round dispatches, this is TRUE device+transfer time
+            with _devtime("serving.forward", bucket=f"b{bucket}"):
+                y = self.endpoint.infer(padded)
+                host = np.asarray(y)  # ONE fetch per micro-batch
         finally:
             if tel.enabled:
                 rec.end("serve.batch", cat="serving")

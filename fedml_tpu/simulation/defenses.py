@@ -87,8 +87,9 @@ class SFedAvgAPI(FedAvgAPI):
         self.score_method = str(getattr(args, "score_method", "acc"))
         self.target_label = getattr(args, "target_label", None)
         self.sv_tol = float(getattr(args, "sv_tol", 0.005))
+        cap = getattr(args, "sv_max_perms", None)
         self.sv_max_perms = int(
-            getattr(args, "sv_max_perms", int(args.client_num_per_round) ** 2)
+            cap if cap is not None else int(args.client_num_per_round) ** 2
         )
         nval = int(getattr(args, "valid_batches", 4))
         self.val_data = _take_batches(

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.compiled import auditable, pow2_budget
+from ..core.devtime import measure as _devtime
 from ..core.frame import bind_operator
 from ..core.aggregation import (
     RobustAggregator,
@@ -575,6 +576,16 @@ class FedAvgAPI:
         self._eval_all = jax.jit(build_eval_all(self._eval))
         self._eval_global = jax.jit(self._eval)
 
+    def _round_exec_name(self) -> str:
+        """Registry name of the round executable this api dispatches —
+        the ``executable`` tag on its ``exec_device_seconds`` series,
+        matched against audit_report.json by ``fedml-tpu perf``."""
+        return (
+            "simulation.round_fn_mesh"
+            if self.mesh is not None
+            else "simulation.round_fn"
+        )
+
     def _post_round_stacked(self, stacked: Params, idx: np.ndarray, rng) -> None:
         """Host-side hook fed the per-client cohort params when
         ``_keep_stacked`` is set (overridden by S-FedAvg / TurboAggregate)."""
@@ -709,15 +720,18 @@ class FedAvgAPI:
                     self.global_params = new_global
                 else:
                     extra = () if lr_mult is None else (lr_mult,)
-                    out = self._round_fn(
-                        self.global_params,
-                        self.server_state,
-                        packed,
-                        nsamples,
-                        np.asarray(idx) if self._multi_controller else jnp.asarray(idx),  # lint: host-sync-ok — idx is host numpy (sampling)
-                        round_rng,
-                        *extra,
-                    )
+                    with _devtime(
+                        self._round_exec_name(), bucket=f"b{len(idx)}"
+                    ):
+                        out = self._round_fn(
+                            self.global_params,
+                            self.server_state,
+                            packed,
+                            nsamples,
+                            np.asarray(idx) if self._multi_controller else jnp.asarray(idx),  # lint: host-sync-ok — idx is host numpy (sampling)
+                            round_rng,
+                            *extra,
+                        )
                     self.global_params, self.server_state, summed = out[:3]
                     if self._keep_stacked:
                         self._post_round_stacked(out[3], idx, round_rng)

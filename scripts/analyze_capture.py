@@ -21,6 +21,24 @@ sys.path.insert(0, _REPO)
 import bench  # noqa: E402
 
 
+def _load_constants():
+    """fedml_tpu/constants.py by file path — the shared peak table and
+    device-kind normalizer, without pulling jax into this readout (the
+    package __init__ imports it)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_fedml_tpu_constants",
+        os.path.join(_REPO, "fedml_tpu", "constants.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+constants = _load_constants()
+
+
 def _get(phases, name):
     return (phases.get(name) or {}).get("result") or {}
 
@@ -46,8 +64,23 @@ def main() -> None:
         print(f"  samples/s/chip      : {dense.get('samples_per_sec_per_chip')}")
         mfu = dense.get("mfu_vs_bf16_peak")
         if mfu is not None:
-            peak = dense.get("peak_assumed_tflops")
-            print(f"  MFU vs bf16 peak    : {mfu:.2%} (peak {peak} TF/s)")
+            # peak from the SHARED table (constants.PEAK_BF16_TFLOPS)
+            # keyed by the record's own meta/device evidence — the same
+            # denominator bench and `fedml-tpu perf` use — falling back
+            # to what the record assumed at capture time
+            meta = dense.get("meta") or {}
+            kind = constants.normalize_device_kind(
+                str(meta.get("device_kind") or dense.get("device") or "")
+            )
+            peak_f = constants.peak_bf16_flops(kind)
+            peak = (
+                peak_f / 1e12 if peak_f > 0
+                else dense.get("peak_assumed_tflops")
+            )
+            print(
+                f"  MFU vs bf16 peak    : {mfu:.2%} "
+                f"(peak {peak} TF/s, {kind or '?'})"
+            )
             verdict = (
                 "MXU well fed" if mfu >= 0.2 else
                 "compute-starved — check buffer plan below" if mfu >= 0.05

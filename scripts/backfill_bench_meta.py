@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Backfill the perf-plane meta block onto existing BENCH records.
+
+New records get their meta from bench.py's ``_phase_main`` (attached
+once, centrally); the checked-in trajectory predates the contract, so
+`fedml-tpu perf --ratchet` needs this one-time migration to have a
+labeled history to seed from. Idempotent: records that already carry a
+meta block are left byte-identical. Crashed driver records (``parsed``
+null, e.g. BENCH_r01) are skipped with a note — there is no result to
+label.
+
+Labeling uses only in-record evidence, never guesses:
+``cpu_fallback`` flags win, then the nearest ``detail.device`` string
+(``"TPU v5 lite0"`` -> ``"TPU v5 lite"``, ``"TFRT_CPU_0"`` -> ``"cpu"``
+via ``fedml_tpu.constants.normalize_device_kind``). Round-end
+certification records are never smoke (``smoke: false``); the CI gate's
+smoke children label themselves.
+
+Usage: python scripts/backfill_bench_meta.py [--dry-run] [FILES...]
+(default FILES: <root>/BENCH_r0*.json + BENCH_TPU_CAPTURE_r04.json)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402  — _meta_headline/_find_mfu (jax-free at import)
+
+
+def _load_constants():
+    """fedml_tpu/constants.py by file path: the package __init__ pulls
+    in jax, which this stdlib-only migration must not."""
+    spec = importlib.util.spec_from_file_location(
+        "_fedml_tpu_constants",
+        os.path.join(ROOT, "fedml_tpu", "constants.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+constants = _load_constants()
+
+
+def _device_kind_for(record: dict, fallback: str) -> str:
+    """In-record evidence only: cpu_fallback flag, then the record's
+    own detail.device / device string, then the enclosing record's."""
+    if record.get("cpu_fallback"):
+        return "cpu"
+    detail = record.get("detail") or {}
+    dev = detail.get("device") or record.get("device") or fallback
+    return constants.normalize_device_kind(str(dev))
+
+
+def _make_meta(phase: str, record: dict, fallback_kind: str) -> dict:
+    kind = _device_kind_for(record, fallback_kind)
+    meta = {
+        "schema": 1,
+        "phase": phase,
+        "device_kind": kind,
+        "backend": "cpu" if kind == "cpu" else "tpu",
+        "smoke": False,
+        "backfilled": True,
+    }
+    value, metric, unit = bench._meta_headline(record)
+    if value is not None:
+        meta.update(value=value, metric=metric, unit=unit)
+    mfu = bench._find_mfu(record)
+    if mfu is not None:
+        meta["mfu"] = mfu
+    return meta
+
+
+def _stamp(record: dict, phase: str, fallback_kind: str, stamped: list, where: str) -> None:
+    if not isinstance(record, dict) or "meta" in record:
+        return
+    record["meta"] = _make_meta(phase, record, fallback_kind)
+    stamped.append(where)
+
+
+def migrate_record(rec: dict) -> list:
+    """Stamp every phase record in one BENCH file; returns the list of
+    stamped locations (empty = already migrated / nothing to do)."""
+    stamped: list = []
+    # driver shape {n, cmd, rc, tail, parsed} vs bare capture file
+    if "parsed" in rec:
+        parsed = rec.get("parsed")
+        if parsed is None:
+            return stamped  # crashed run: no result to label
+    else:
+        parsed = rec
+    # watcher capture shape {provenance, phases: {name: {result}}}
+    phases = rec.get("phases")
+    if isinstance(phases, dict) and "parsed" not in rec:
+        for name, entry in phases.items():
+            result = (entry or {}).get("result")
+            if isinstance(result, dict):
+                _stamp(result, name, "cpu", stamped, f"phases.{name}")
+        return stamped
+    if not isinstance(parsed, dict):
+        return stamped
+    record_kind = _device_kind_for(parsed, "cpu")
+    _stamp(parsed, "headline", record_kind, stamped, "headline")
+    detail = parsed.get("detail") or {}
+    for key in bench.PHASE_CHOICES:
+        sub = detail.get(key)
+        if isinstance(sub, dict):
+            _stamp(sub, key, record_kind, stamped, f"detail.{key}")
+    sidecar = detail.get("tpu_capture_sidecar") or {}
+    for name, entry in (sidecar.get("phases") or {}).items():
+        result = (entry or {}).get("result")
+        if isinstance(result, dict):
+            # sidecar phases were captured on the live tunnel: their
+            # own detail.device decides, defaulting to the TPU side
+            _stamp(result, name, "TPU", stamped, f"sidecar.{name}")
+    return stamped
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("files", nargs="*", help="BENCH record files")
+    p.add_argument("--dry-run", action="store_true")
+    a = p.parse_args(argv)
+    files = a.files or sorted(
+        glob.glob(os.path.join(ROOT, "BENCH_r0*.json"))
+        + glob.glob(os.path.join(ROOT, "BENCH_TPU_CAPTURE_*.json"))
+    )
+    rc = 0
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"backfill: {path}: unreadable ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        if isinstance(rec, dict) and rec.get("parsed") is None and "parsed" in rec:
+            print(f"backfill: {path}: skipped (crashed run, parsed=null)")
+            continue
+        stamped = migrate_record(rec)
+        if not stamped:
+            print(f"backfill: {path}: already migrated")
+            continue
+        if a.dry_run:
+            print(f"backfill: {path}: WOULD stamp {', '.join(stamped)}")
+            continue
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, path)
+        print(f"backfill: {path}: stamped {', '.join(stamped)}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

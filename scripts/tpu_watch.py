@@ -129,6 +129,51 @@ MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 STOP_NOTE = "killed by stop-file (box handed over)"
 
 
+def _find_num(node, keys):
+    """First numeric value under any of ``keys`` anywhere in a nested
+    phase record (the perf plane nests its readout per phase shape)."""
+    if isinstance(node, dict):
+        for k in keys:
+            v = node.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+        for v in node.values():
+            found = _find_num(v, keys)
+            if found is not None:
+                return found
+    elif isinstance(node, list):
+        for v in node:
+            found = _find_num(v, keys)
+            if found is not None:
+                return found
+    return None
+
+
+def _perf_column(result: dict) -> str:
+    """The live MFU/idle readout for one captured phase, sourced from
+    the perf plane's series — the meta block bench.py stamps centrally
+    (``mfu`` = ``mfu_vs_bf16_peak``) and the idle ledger's
+    ``wire_utilization_frac`` — instead of per-phase math here."""
+    meta = result.get("meta") if isinstance(result, dict) else None
+    meta = meta if isinstance(meta, dict) else {}
+    bits = []
+    if meta.get("device_kind"):
+        bits.append(str(meta["device_kind"]))
+    if meta.get("value") is not None:
+        bits.append(f"{meta['value']} {meta.get('metric', '')}".strip())
+    mfu = meta.get("mfu")
+    if mfu is None:
+        mfu = _find_num(result, ("mfu_vs_bf16_peak",))
+    if mfu is not None:
+        bits.append(f"mfu {mfu:.2%}")
+    wire = _find_num(
+        result, ("mean_wire_utilization_frac", "wire_utilization_frac")
+    )
+    if wire is not None:
+        bits.append(f"wire {wire:.1%}")
+    return " | ".join(bits) if bits else "no perf readout"
+
+
 def _utcnow() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%SZ"
@@ -389,7 +434,25 @@ def main() -> None:
                 }
                 _save_capture(cap)
                 _log(f"phase {name}: CAPTURED in {dt:.0f}s ({note})")
+                _log(f"  perf: {_perf_column(result)}")
                 tel.inc("tpu_watch_phases_total", phase=name, outcome="captured")
+                # live MFU/idle gauges from the perf plane's readout —
+                # the .prom exposition gets the same column the log does
+                meta = result.get("meta") if isinstance(result, dict) else None
+                mfu = (meta or {}).get("mfu")
+                if mfu is None:
+                    mfu = _find_num(result, ("mfu_vs_bf16_peak",))
+                if mfu is not None:
+                    tel.set_gauge("tpu_watch_mfu_frac", float(mfu), phase=name)
+                wire = _find_num(
+                    result,
+                    ("mean_wire_utilization_frac", "wire_utilization_frac"),
+                )
+                if wire is not None:
+                    tel.set_gauge(
+                        "tpu_watch_wire_utilization_frac", float(wire),
+                        phase=name,
+                    )
             else:
                 _save_capture(cap)  # attempt counter (or refund) sticks
                 _log(f"phase {name}: failed ({note})")

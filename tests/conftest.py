@@ -30,11 +30,13 @@ def _reset_observability_singletons():
     into every later test in the worker."""
     prev_threefry = jax.config.jax_threefry_partitionable
     yield
+    from fedml_tpu.core import devtime
     from fedml_tpu.core.chaos import reset_chaos
     from fedml_tpu.core.telemetry import Telemetry
     from fedml_tpu.core.tracking import ProfilerEvent, RunLogger
 
     Telemetry.reset()
+    devtime.reset()
     ProfilerEvent.reset()
     RunLogger.reset()
     # the chaos plane (schedule + durable-IO seam) is process-global

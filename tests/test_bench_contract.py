@@ -612,6 +612,85 @@ class TestPhaseChild:
         assert d["ok"] is True
 
 
+class TestMetaBlock:
+    """Every bench record carries the mandatory perf-plane meta block
+    (`fedml-tpu perf --ratchet` groups by it): device_kind / backend /
+    smoke labels plus the phase headline it compares. The phase child
+    stamps it centrally in _phase_main; the checked-in trajectory was
+    backfilled once by scripts/backfill_bench_meta.py."""
+
+    def test_meta_headline_prefers_explicit_value(self):
+        v, metric, unit = bench._meta_headline(
+            {"value": 1.5, "metric": "rounds/s", "unit": "rounds/s",
+             "rounds_per_sec": 9.9}
+        )
+        assert (v, metric, unit) == (1.5, "rounds/s", "rounds/s")
+
+    def test_meta_headline_falls_back_to_throughput_keys(self):
+        v, metric, unit = bench._meta_headline(
+            {"rounds_per_sec": 2.5, "zzz": 1.0}
+        )
+        assert (v, metric) == (2.5, "rounds_per_sec")
+
+    def test_meta_headline_deterministic_last_resort(self):
+        # no headline, no known key: first numeric by sorted key — the
+        # same record shape must always yield the same ratchet metric
+        v, metric, _ = bench._meta_headline({"b_ms": 3.0, "a_ms": 7.0})
+        assert (v, metric) == (7.0, "a_ms")
+        assert bench._meta_headline({"note": "x"}) == (None, None, None)
+
+    def test_find_mfu_recurses_and_ignores_bools(self):
+        rec = {"detail": {"dense": [{"mfu_vs_bf16_peak": 0.031}]},
+               "mfu_vs_bf16_peak_flag": True}
+        assert bench._find_mfu(rec) == 0.031
+        assert bench._find_mfu({"mfu_vs_bf16_peak": True}) is None
+
+    def test_bench_meta_contract_keys(self):
+        meta = bench._bench_meta("dense", True, {"rounds_per_sec": 2.0})
+        assert meta["schema"] == 1
+        assert meta["phase"] == "dense"
+        assert meta["smoke"] is True
+        # labels come from the live backend — on the CI box that is cpu
+        assert meta["device_kind"]
+        assert meta["backend"]
+        assert meta["value"] == 2.0
+
+    def test_phase_child_stamps_meta_centrally(self):
+        # ONE stamping site, in the child's serializer — a new phase
+        # cannot forget the contract
+        import inspect
+
+        src = inspect.getsource(bench._phase_main)
+        assert "_bench_meta" in src
+
+    def test_checked_in_trajectory_is_labeled(self):
+        """The ratchet's seed history: every parseable checked-in BENCH
+        record carries a meta block (backfilled); only the crashed
+        r01 driver record (parsed: null) is exempt."""
+        import glob
+
+        from fedml_tpu.analysis import perf
+
+        paths = sorted(
+            glob.glob(os.path.join(REPO, "BENCH_r0*.json"))
+            + glob.glob(os.path.join(REPO, "BENCH_TPU_CAPTURE_*.json"))
+        )
+        assert paths, "checked-in BENCH trajectory missing"
+        labeled = 0
+        for path in paths:
+            metas, skip = perf.extract_bench_metas(path)
+            if skip is not None:
+                assert "BENCH_r01" in path, (path, skip)
+                continue
+            assert metas, f"{path}: no meta blocks"
+            for meta in metas:
+                assert meta["schema"] == 1, path
+                assert meta["device_kind"], path
+                assert isinstance(meta["smoke"], bool), path
+            labeled += 1
+        assert labeled >= 4
+
+
 class TestCaptureSidecar:
     """_attach_capture_sidecar folds the tunnel-watcher's capture into
     the round-end JSON exactly when TPU numbers are missing from the
