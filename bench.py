@@ -1031,9 +1031,196 @@ def run_serving(on_cpu: bool, smoke: bool = False) -> dict:
     out["shed_queue_full"] = tel.get_counter(
         "serving_shed_total", reason="queue_full"
     )
+    out["mesh"] = _serving_mesh_variant(model, params, args, smoke)
+    out["fleet"] = _serving_fleet_variant(model, params, args, smoke, tel)
     if on_cpu:
         out["cpu_fallback"] = True
     return out
+
+
+def _serving_mesh_variant(model, params, args, smoke: bool) -> dict:
+    """Mesh-endpoint half of detail.serving: the SAME deterministic
+    request set served through ``MeshModelEndpoint`` at two (data,
+    fsdp) mesh shapes — (1,1) and (2,2) device-prefix submeshes —
+    across 2 mid-run hot swaps each. The gate: responses **bitwise
+    identical** across shapes for every published version (the serving
+    half of the multichip identity), exactly one jit trace per bucket
+    (swaps never retrace, swap counter == 2), req/s + p99 per shape.
+    With < 4 visible devices the (2,2) shape records a skip reason
+    instead of silently shrinking coverage."""
+    import numpy as np
+    import jax
+
+    from fedml_tpu.parallel.layout import build_fed_mesh
+    from fedml_tpu.serving import MeshModelEndpoint, ServingEngine
+
+    n_dev = len(jax.devices())
+    shapes = [(1, 1), (2, 2)]
+    rs = np.random.RandomState(7)
+    bursts = (3, 12)  # -> buckets 4 and 16, both tile 1 and 2 lanes
+    iters = 2 if smoke else 6
+    fixed = [
+        [rs.randn(*model.example_shape).astype(np.float32) for _ in range(b)]
+        for b in bursts
+    ]
+    # 2 deterministic publishes, identical for every shape
+    published = [
+        jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(101 + i)))
+        for i in range(2)
+    ]
+    mesh_out: dict = {"shapes": {}, "skipped": {}}
+    responses: dict = {}
+    for d, f in shapes:
+        key = f"{d}x{f}"
+        if d * f > n_dev:
+            mesh_out["skipped"][key] = (
+                f"needs {d * f} devices, have {n_dev}"
+            )
+            continue
+        mesh = build_fed_mesh(
+            mesh_shape={"data": d, "fsdp": f}, warn_nonpartitionable=False
+        )
+        ep = MeshModelEndpoint(model, params, mesh)
+        eng = ServingEngine(ep, args).start()
+        lats: list = []
+        resp: list = []
+        served = 0
+        t_start = None
+        try:
+            def serve_fixed(measure: bool) -> None:
+                nonlocal served, t_start
+                for xs in fixed:
+                    for _ in range(iters):
+                        eng.pause()
+                        futs = [eng.submit(x) for x in xs]
+                        eng.resume()
+                        t0 = time.perf_counter()
+                        rows = [
+                            np.asarray(fu.result(timeout=120)) for fu in futs
+                        ]
+                        dt = time.perf_counter() - t0
+                        if measure:
+                            if t_start is None:
+                                t_start = t0
+                            lats.extend([dt] * len(xs))
+                            served += len(xs)
+                    resp.append(np.stack(rows))
+
+            # warmup pass compiles both buckets, then the measured run
+            serve_fixed(measure=False)
+            serve_fixed(measure=True)
+            for step, pub in enumerate(published):
+                ep.swap(pub, version=step + 1)
+                serve_fixed(measure=True)
+        finally:
+            eng.stop()
+        wall = max(time.perf_counter() - (t_start or 0.0), 1e-9)
+        responses[key] = np.concatenate([r.ravel() for r in resp])
+        mesh_out["shapes"][key] = {
+            "devices": d * f,
+            "requests": served,
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "req_per_sec": round(served / wall, 1),
+            "swaps": ep.swaps,
+            "jit_traces": {str(k): v for k, v in ep.trace_counts.items()},
+            "one_trace_per_bucket": all(
+                v == 1 for v in ep.trace_counts.values()
+            ) and len(ep.trace_counts) >= 2,
+        }
+        _progress(
+            f"serving mesh {key}: p99 "
+            f"{mesh_out['shapes'][key]['p99_ms']} ms, "
+            f"swaps {ep.swaps}"
+        )
+    if len(responses) >= 2:
+        keys = sorted(responses)
+        base = responses[keys[0]]
+        diff = max(
+            float(np.max(np.abs(responses[k] - base))) for k in keys[1:]
+        )
+        mesh_out["max_abs_diff_across_shapes"] = diff
+        mesh_out["bitwise_identical_across_shapes"] = all(
+            np.array_equal(responses[k], base) for k in keys[1:]
+        )
+    else:
+        # one shape is no identity check — loud, never silent
+        mesh_out["bitwise_identical_across_shapes"] = None
+    return mesh_out
+
+
+def _serving_fleet_variant(model, params, args, smoke: bool, tel) -> dict:
+    """Fleet half of detail.serving: 2 endpoints behind the load-aware
+    frontend seam. A paused-fleet burst measures queue depth, routed
+    request counts prove <= 2x load skew, a mid-run fleet-wide hot swap
+    rides along, and the occupancy histogram summarizes batching."""
+    import numpy as np
+    import jax
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.serving import ServingFleet
+
+    fa = Arguments()
+    fa.dataset = "synthetic"
+    fa.input_dim = args.input_dim
+    fa.model = args.model
+    fa.serve_deadline_ms = 0.0
+    fa.serve_fleet_size = 2
+    fa._validate()
+    rs = np.random.RandomState(11)
+    n_req = 24 if smoke else 96
+    xs = [
+        rs.randn(*model.example_shape).astype(np.float32)
+        for _ in range(n_req)
+    ]
+    fleet = ServingFleet.build(model, params, fa).start()
+    try:
+        # warmup both endpoints' buckets
+        for fu in fleet.submit_burst(xs[: 2 * len(fleet.engines)]):
+            fu.result(timeout=120)
+        for e in fleet.engines:
+            e.pause()
+        t0 = time.perf_counter()
+        futs = [fleet.submit(x) for x in xs]
+        depth_max = max(fleet.depths())
+        for e in fleet.engines:
+            e.resume()
+        lats = []
+        for fu in futs:
+            fu.result(timeout=120)
+            lats.append(time.perf_counter() - t0)
+        wall = max(time.perf_counter() - t0, 1e-9)
+        # fleet-wide hot swap mid-run, then one more routed burst
+        fleet.hot_swap(
+            jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(103)))
+        )
+        for fu in [fleet.submit(x) for x in xs[: len(xs) // 2]]:
+            fu.result(timeout=120)
+    finally:
+        fleet.stop()
+    snap = tel.snapshot()
+    occ = None
+    for k, h in snap.get("histograms", {}).items():
+        if k.startswith("serving_batch_occupancy_frac") and h.get("count"):
+            occ = round(float(h["sum"]) / float(h["count"]), 3)
+    return {
+        "endpoints": len(fleet.engines),
+        "routed": list(fleet.routed),
+        "load_skew": (
+            None if fleet.load_skew() == float("inf") else
+            round(fleet.load_skew(), 3)
+        ),
+        "depth_max": depth_max,
+        "occupancy_frac": occ,
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "req_per_sec": round(n_req / wall, 1),
+        "failovers": tel.get_counter("serving_fleet_failover_total"),
+        "sheds": sum(
+            v for k, v in tel.counters_matching(
+                "serving_fleet_shed_total"
+            ).items()
+        ),
+        "swaps": fleet.engines[0].endpoint.swaps,
+    }
 
 
 def run_chaos(on_cpu: bool, smoke: bool = False) -> dict:
@@ -3614,7 +3801,7 @@ _PIPELINE_TIMEOUT_S = 300.0
 # warmup compile + two timed train() runs (telemetry off/on) on the
 # same jitted fns
 _TELEMETRY_TIMEOUT_S = 240.0
-_SERVING_TIMEOUT_S = 180.0
+_SERVING_TIMEOUT_S = 300.0  # fleet + two mesh shapes ride along now
 # two LOCAL worlds (clean + chaos) with a kill and a server restart;
 # dominated by jit compiles on a cold 1-core box
 _CHAOS_TIMEOUT_S = 300.0
@@ -4089,10 +4276,14 @@ def _phase_main(argv) -> None:
         # the mesh phase needs devices to shard over — 2 virtual CPU
         # devices (more drowns the 1-core box in collective emulation);
         # multichip forces the full 8-device (data, fsdp) world (the
-        # LR model keeps collective emulation cheap); other phases 1
-        _force_cpu(
-            8 if a.phase == "multichip" else (2 if a.phase == "mesh" else 1)
-        )
+        # LR model keeps collective emulation cheap); serving needs 8
+        # too for its (1,1)-vs-(2,2) mesh-endpoint submeshes; others 1
+        if a.phase == "serving":
+            _force_cpu(8)
+        else:
+            _force_cpu(
+                8 if a.phase == "multichip" else (2 if a.phase == "mesh" else 1)
+            )
     if a.phase == "headline":
         out = run_headline(on_cpu=a.cpu)
     elif a.phase == "bf16":

@@ -58,10 +58,13 @@ python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_telemetry_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
 
-# Serving smoke (two buckets, 2 hot-swaps, CPU): the serving plane must
-# run end-to-end through bench.py's serving phase child and emit the
-# detail.serving contract keys — p50/p99 + req/s per bucket, exactly one
-# jit trace per bucket across the swaps, and a counted queue-full shed.
+# Serving smoke (two buckets, 2 hot-swaps, CPU, 8 virtual devices): the
+# serving plane must run end-to-end through bench.py's serving phase
+# child and emit the detail.serving contract keys — p50/p99 + req/s per
+# bucket, exactly one jit trace per bucket across the swaps, a counted
+# queue-full shed — PLUS the mesh/fleet gate: bitwise-identical
+# responses across the (1,1) and (2,2) mesh shapes through 2 mid-run
+# sharded hot swaps, and a 2-endpoint fleet routing within 2x load skew.
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_serving_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider

@@ -60,6 +60,7 @@ AUDITED_MODULES = (
     "fedml_tpu.simulation.fedavg_api",
     "fedml_tpu.scale.engine",
     "fedml_tpu.serving.endpoint",
+    "fedml_tpu.serving.mesh_endpoint",
 )
 
 

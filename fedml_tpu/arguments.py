@@ -329,6 +329,25 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_bucket": "pow2",
     # checkpoint publish/watch poll interval for weight hot-swaps
     "serve_watch_interval_s": 1.0,
+    # serving fleet: number of endpoints behind the fleet frontend
+    # (1 = the classic single-endpoint plane, no fleet layer)
+    "serve_fleet_size": 1,
+    # serve on a named (data, fsdp) mesh: {"data": D, "fsdp": F} makes
+    # every endpoint a MeshModelEndpoint (params at their at-rest
+    # SpecLayout shardings, batches sharded along data). None = serve
+    # single-device
+    "serve_mesh": None,
+    # fleet routing policy: "least_loaded" (argmin queue depth per
+    # request) or "static" (the boustrophedon deal cycled —
+    # core/scheduler.assign_by_load)
+    "serve_route_policy": "least_loaded",
+    # fleet SLO shed signal: when the p99 of serving_request_latency_s
+    # exceeds this, new requests shed at the fleet door
+    # (serving_fleet_shed_total{reason=slo}). 0 disables
+    "serve_route_slo_ms": 0.0,
+    # on an immediately-shed submission (queue full / stopped engine)
+    # retry this many more candidates before giving up
+    "serve_route_failover": 1,
     # sequence-parallel strategy: "ring" or "ulysses"
     "sp_strategy": "ring",
     # ring attention: chunk each hop's K/V shard so the per-chip score
@@ -560,6 +579,8 @@ class Arguments:
             "pipeline_depth",
             "serve_queue_size",
             "serve_max_batch",
+            "serve_fleet_size",
+            "serve_route_failover",
             "comm_retry_max",
         ):
             setattr(self, int_key, int(getattr(self, int_key)))
@@ -588,6 +609,7 @@ class Arguments:
             "serve_batch_wait_ms",
             "serve_deadline_ms",
             "serve_watch_interval_s",
+            "serve_route_slo_ms",
             "comm_retry_base_s",
             "grpc_send_timeout_s",
             "heartbeat_interval_s",
@@ -759,6 +781,7 @@ class Arguments:
             )
         for nonneg_key in (
             "serve_batch_wait_ms", "serve_deadline_ms", "serve_watch_interval_s",
+            "serve_route_slo_ms", "serve_route_failover",
         ):
             if getattr(self, nonneg_key) < 0:
                 raise ValueError(
@@ -768,6 +791,28 @@ class Arguments:
             raise ValueError(
                 f"serve_bucket {self.serve_bucket!r}: pick 'pow2' or 'exact'"
             )
+        if self.serve_fleet_size < 1:
+            raise ValueError(
+                f"serve_fleet_size={self.serve_fleet_size}: must be >= 1 "
+                "(1 = single endpoint, no fleet layer)"
+            )
+        if getattr(self, "serve_route_policy", "least_loaded") not in (
+            "least_loaded", "static",
+        ):
+            raise ValueError(
+                f"serve_route_policy {self.serve_route_policy!r}: pick "
+                "'least_loaded' or 'static'"
+            )
+        serve_mesh = getattr(self, "serve_mesh", None)
+        if serve_mesh is not None:
+            if not isinstance(serve_mesh, dict) or not set(
+                serve_mesh
+            ) <= {"data", "fsdp"}:
+                raise ValueError(
+                    f"serve_mesh={serve_mesh!r}: expected a dict with "
+                    "'data'/'fsdp' axis sizes (e.g. {'data': 2, 'fsdp': 2})"
+                )
+            self.serve_mesh = {k: int(v) for k, v in serve_mesh.items()}
         if getattr(self, "stall_timeout_s", 0.0) < 0:
             raise ValueError(
                 f"stall_timeout_s={self.stall_timeout_s}: must be >= 0 "

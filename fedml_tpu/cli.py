@@ -188,17 +188,20 @@ def cmd_serve(args) -> int:
     Builds the model from the YAML config (``--cf``), restores the
     newest restorable checkpoint from ``--checkpoint-dir`` (corrupt
     latest falls back to the previous version — CheckpointWatcher
-    semantics), starts the micro-batching engine, and keeps hot-swapping
-    weights as the trainer publishes new rounds. ``--dry-run`` builds
-    everything, prints one status JSON line, and exits — the smoke seam
-    for tests and deploy scripts."""
+    semantics), starts the fleet (``--fleet-size`` micro-batching
+    engines behind one load-aware frontend; size 1 = the classic single
+    endpoint), and keeps hot-swapping weights as the trainer publishes
+    new rounds. ``--mesh DxF`` serves every endpoint pjit'd over a
+    named (data, fsdp) mesh with publishes restored device-direct onto
+    it. ``--dry-run`` builds everything, prints one status JSON line,
+    and exits — the smoke seam for tests and deploy scripts."""
     import importlib
 
     jax = importlib.import_module("jax")
     from .arguments import Arguments
     from . import models
     from .core.checkpoint import CheckpointWatcher
-    from .serving import ModelEndpoint, ServingEngine, ServingFrontend
+    from .serving import FleetFrontend, ServingFleet
     from .serving.frontends import build_serving_com
 
     ns = argparse.Namespace(
@@ -208,47 +211,70 @@ def cmd_serve(args) -> int:
         run_id=args.run_id,
     )
     a = Arguments(ns)
+    if args.fleet_size is not None:
+        a.serve_fleet_size = max(1, int(args.fleet_size))
+    if args.mesh:
+        try:
+            d, f = (int(t) for t in str(args.mesh).lower().split("x"))
+        except ValueError:
+            print(f"serve: --mesh {args.mesh!r} is not DATAxFSDP (e.g. 2x2)",
+                  file=sys.stderr)
+            return 2
+        a.serve_mesh = {"data": d, "fsdp": f}
+    mesh = None
+    if getattr(a, "serve_mesh", None):
+        from .parallel.layout import build_fed_mesh
+
+        # serving draws no in-jit randomness, so the threefry
+        # partitionability warning would be noise here
+        mesh = build_fed_mesh(
+            mesh_shape=a.serve_mesh, warn_nonpartitionable=False
+        )
     model = models.create(a, int(args.output_dim))
     params = model.init(jax.random.PRNGKey(int(a.random_seed)))
-    endpoint = ModelEndpoint(model, params, version=0)
+    fleet = ServingFleet.build(model, params, a, mesh=mesh)
 
     watcher = None
     if args.checkpoint_dir:
+        # restore_target: after the first (host-side) publish teaches
+        # the fleet the state tree, mesh restores land device-direct
         watcher = CheckpointWatcher(
-            args.checkpoint_dir, poll_interval_s=a.serve_watch_interval_s
+            args.checkpoint_dir,
+            poll_interval_s=a.serve_watch_interval_s,
+            restore_target=fleet.restore_target,
         )
         update = watcher.poll()
         if update is not None:
             step, state = update
-            endpoint.swap_from_checkpoint_state(state, version=step)
+            fleet.publish_state(state, step)
             print(f"serve: loaded checkpoint step {step}", file=sys.stderr)
 
-    engine = ServingEngine(endpoint, a).start()
+    fleet.start()
+    engine = fleet.engines[0]
     status = {
         "model": model.name,
-        "version": endpoint.version,
+        "version": engine.endpoint.version,
         "backend": args.backend,
         "queue_size": engine.queue_size,
         "max_batch": engine.max_batch,
         "bucket_policy": engine.bucket_policy,
         "deadline_ms": a.serve_deadline_ms,
         "checkpoint_dir": args.checkpoint_dir,
+        "fleet_size": len(fleet.engines),
+        "mesh": getattr(a, "serve_mesh", None),
+        "route_policy": fleet.route_policy,
     }
     if args.dry_run:
         print(json.dumps(status))
-        engine.stop()
+        fleet.stop()
         if watcher is not None:
             watcher.close()
         return 0
 
     com = build_serving_com(a, rank=0, size=int(args.world_size), backend=args.backend)
-    frontend = ServingFrontend(engine, com, a, rank=0)
+    frontend = FleetFrontend(fleet, com, a, rank=0)
     if watcher is not None:
-        watcher.watch(
-            lambda step, state: endpoint.swap_from_checkpoint_state(
-                state, version=step
-            )
-        )
+        watcher.watch(lambda step, state: fleet.publish_state(state, step))
     print(f"serve: ready ({json.dumps(status)})", file=sys.stderr)
     try:
         frontend.serve_forever()
@@ -256,7 +282,7 @@ def cmd_serve(args) -> int:
         pass  # way to stop `serve`; the finally below shuts down cleanly
     finally:
         frontend.stop()
-        engine.stop()
+        fleet.stop()
         if watcher is not None:
             watcher.close()
         from .core.telemetry import Telemetry
@@ -471,6 +497,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     serve.add_argument("--world-size", type=int, default=2)
     serve.add_argument("--output-dim", type=int, default=10)
+    serve.add_argument(
+        "--fleet-size", type=int, default=None,
+        help="endpoints behind the fleet frontend (default: "
+        "serve_fleet_size knob)",
+    )
+    serve.add_argument(
+        "--mesh", default=None, metavar="DATAxFSDP",
+        help="serve on a named (data, fsdp) mesh, e.g. 2x2 "
+        "(default: serve_mesh knob; omit to serve single-device)",
+    )
     serve.add_argument("--run-id", dest="run_id", default="0")
     serve.add_argument("--dry-run", action="store_true")
     serve.set_defaults(fn=cmd_serve)

@@ -328,13 +328,30 @@ class CheckpointWatcher:
     is returned instead.
     """
 
-    def __init__(self, checkpoint_dir: str, poll_interval_s: float = 1.0) -> None:
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        poll_interval_s: float = 1.0,
+        restore_target: Any = None,
+    ) -> None:
         self.ckpt = RoundCheckpointer(checkpoint_dir)
         self.poll_interval_s = float(poll_interval_s)
         self.published_step: Optional[int] = None
+        # abstract restore target (a pytree, or a zero-arg callable
+        # returning one / None): when set, each poll restores straight
+        # onto it — sharding-carrying leaves land device-direct on
+        # their mesh placement, no host gather. None = raw host restore
+        # (the pre-mesh behavior). A callable lets a subscriber grow
+        # the target lazily (the fleet learns the state tree from its
+        # first — host-side — publish).
+        self.restore_target = restore_target
         self._bad: set = set()
         self._closed = threading.Event()  # stops every watch() loop
         self._threads: List[threading.Thread] = []
+
+    def _target(self) -> Any:
+        t = self.restore_target
+        return t() if callable(t) else t
 
     def poll(self) -> Optional[Tuple[int, Dict[str, Any]]]:
         """The newest restorable step newer than the last published
@@ -351,7 +368,10 @@ class CheckpointWatcher:
             reverse=True,
         ):
             try:
-                state = self.ckpt.restore(step)
+                # the target lookup stays INSIDE the try: a target that
+                # no longer matches a (stale) step must degrade to the
+                # previous version exactly like a corrupt step does
+                state = self.ckpt.restore(step, target=self._target())
             except Exception:  # noqa: BLE001 — corrupt/partial: fall back
                 logging.exception(
                     "checkpoint watcher: step %d failed to restore; "

@@ -12,7 +12,8 @@ Wired into the round loop via the planet-scale population plane
 heterogeneity-aware workloads (samples x ``2**speed_tier``) and
 ``balance_clients_across_shards`` to deal each group's clients across
 mesh lanes; ``fedml_tpu/scale/tree.py`` reuses the boustrophedon deal
-for load-balanced client->edge assignment. Under classic eager packing
+(via ``assign_by_load``) for load-balanced client->edge assignment and
+``fedml_tpu/serving/fleet.py`` for static request->endpoint routing. Under classic eager packing
 every client trains the same number of (masked) batches, so those
 paths still do not consume it — the seam's consumer is the per-group
 bucketed packer.
@@ -97,6 +98,18 @@ def best_makespan(
     if native is not None:
         return native
     return greedy_makespan(workloads, num_resources)
+
+
+def assign_by_load(
+    load_sizes: Sequence[float], num_targets: int
+) -> Dict[int, int]:
+    """index -> target map over the boustrophedon deal: near-equal
+    total load per target with equal counts. The flat-dict face of
+    ``balance_clients_across_shards`` — the edge aggregation tree maps
+    client ids to edges with it, the serving fleet statically deals a
+    request burst across endpoints with it."""
+    shards = balance_clients_across_shards(list(load_sizes), int(num_targets))
+    return {int(i): t for t, lane in enumerate(shards) for i in lane}  # lint: host-sync-ok — host ints
 
 
 def balance_clients_across_shards(

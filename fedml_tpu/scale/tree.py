@@ -28,7 +28,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..core.aggregation import StreamingAccumulator
-from ..core.scheduler import balance_clients_across_shards
+from ..core.scheduler import assign_by_load as _assign_by_load
 from ..core.topology import EdgeTreeTopology
 
 Params = Any
@@ -66,9 +66,8 @@ class EdgeAggregationTree:
         client_sizes: Sequence[int], edge_num: int
     ) -> Dict[int, int]:
         """index -> edge, near-equal total load per edge
-        (``core/scheduler.balance_clients_across_shards``)."""
-        shards = balance_clients_across_shards(list(client_sizes), edge_num)
-        return {int(i): e for e, lane in enumerate(shards) for i in lane}  # lint: host-sync-ok — host rank ints
+        (``core/scheduler.assign_by_load``)."""
+        return _assign_by_load(client_sizes, edge_num)
 
     # -- routing ------------------------------------------------------
     def edge_of(self, index: int) -> int:

@@ -12,8 +12,15 @@ Pieces (each documented in its module; overview in docs/serving.md):
 
 - ``ModelEndpoint`` — versioned params + jit-once forward; hot swaps
   are atomic and provably retrace-free;
+- ``MeshModelEndpoint`` — the same endpoint pjit'd over the named
+  (data, fsdp) mesh: params served from their at-rest SpecLayout
+  shardings, publishes restored device-direct, responses bitwise
+  identical across mesh shapes;
 - ``ServingEngine`` — bounded queue, continuous micro-batching into
   pow2 buckets, deadline/queue-full load shedding;
+- ``ServingFleet`` / ``FleetFrontend`` — N endpoints behind one
+  load-aware, SLO-shedding frontend (``core/scheduler.assign_by_load``
+  routing, counted failover);
 - ``ServingFrontend`` / ``ServingClient`` — the request/response pair
   over LOCAL or gRPC comm backends (``fedml_tpu.cli serve``).
 """
@@ -27,25 +34,38 @@ from .admission import (  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .endpoint import ModelEndpoint  # noqa: F401
 from .engine import LATENCY_BUCKETS_S, InferenceRequest, ServingEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetFrontend,
+    FleetSloError,
+    ServingFleet,
+    SloController,
+)
 from .frontends import (  # noqa: F401
     ServingClient,
     ServingFrontend,
     ServingUnavailableError,
     build_serving_com,
 )
+from .mesh_endpoint import MeshModelEndpoint, build_mesh_forward  # noqa: F401
 
 __all__ = [
     "AdmissionController",
     "DeadlineExceededError",
+    "FleetFrontend",
+    "FleetSloError",
     "InferenceRequest",
     "LATENCY_BUCKETS_S",
+    "MeshModelEndpoint",
     "MicroBatcher",
     "ModelEndpoint",
     "QueueFullError",
     "ServingClient",
     "ServingEngine",
+    "ServingFleet",
     "ServingFrontend",
     "ServingShedError",
     "ServingUnavailableError",
+    "SloController",
+    "build_mesh_forward",
     "build_serving_com",
 ]

@@ -12,8 +12,9 @@ invariants keep latency flat under continuous retraining:
   the round engine's ``pipeline_retraces_total`` discipline.
 - **Swaps never retrace.** ``swap`` replaces the params pytree
   atomically under a lock, after asserting the new tree has identical
-  structure/shapes/dtypes — the jit cache keys on abstract values, so
-  a shape-identical swap is invisible to XLA. Weights published by the
+  structure/shapes/dtypes/**shardings** — the jit cache keys on
+  abstract values *including placement*, so only a fully
+  abstract-identical swap is invisible to XLA. Weights published by the
   round pipeline / ``CheckpointManager`` always satisfy this (same
   model config), and a mismatched tree fails loudly BEFORE any request
   can hit a retrace storm.
@@ -76,11 +77,18 @@ def build_forward(apply_fn, on_trace=None):
 
 
 def _tree_spec(tree):
-    """Structure + per-leaf (shape, dtype) — metadata only, no device
-    reads — for the swap compatibility check."""
+    """Structure + per-leaf (shape, dtype, sharding) — metadata only,
+    no device reads — for the swap compatibility check. Sharding is
+    part of the jit cache key exactly like shape/dtype: a
+    differently-placed pytree of identical shapes still retraces, so
+    it must fail the swap the same way."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return treedef, [
-        (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", type(a).__name__)))
+        (
+            tuple(getattr(a, "shape", ())),
+            str(getattr(a, "dtype", type(a).__name__)),
+            getattr(a, "sharding", None),
+        )
         for a in leaves
     ]
 
@@ -88,10 +96,15 @@ def _tree_spec(tree):
 class ModelEndpoint:
     """The served (model, params, version) triple behind the engine."""
 
+    #: serve buckets must be a multiple of this (1 = no constraint;
+    #: the mesh endpoint overrides it with the data-axis lane count so
+    #: every micro-batch tiles the cohort axis)
+    shard_multiple: int = 1
+
     def __init__(self, model, params: Params, version: int = 0) -> None:
         self.model = model
         self._lock = threading.Lock()
-        self._params = jax.tree.map(jnp.asarray, params)
+        self._params = self._place(params)
         self.version = int(version)
         self.swaps = 0
         # bucket -> trace count, incremented at TRACE time only (the
@@ -113,7 +126,23 @@ class ModelEndpoint:
                     "serve.jit_trace", cat="compile", bucket=bucket
                 )
 
-        self._fwd = jax.jit(build_forward(self.model.apply, on_trace))
+        self._fwd = jax.jit(self._build_forward(on_trace))
+
+    def _build_forward(self, on_trace):
+        """Hook: the (unjitted) function the endpoint jits. The mesh
+        endpoint overrides this with the sharding-constrained mesh
+        forward; the trace-count seam stays identical either way."""
+        return build_forward(self.model.apply, on_trace)
+
+    # -- placement -----------------------------------------------------
+    def _place(self, params: Params) -> Params:
+        """Device placement for incoming params — both the initial tree
+        and every published swap go through the SAME placement, so the
+        sharding half of the swap identity check compares like with
+        like. The base endpoint is single-device (``jnp.asarray`` →
+        default device); the mesh endpoint overrides this with the
+        SpecLayout at-rest placement."""
+        return jax.tree.map(jnp.asarray, params)
 
     # -- inference -----------------------------------------------------
     def params(self) -> Params:
@@ -132,15 +161,17 @@ class ModelEndpoint:
         version (``version`` or the old version + 1). Raises
         ``ValueError`` when the new tree would change any abstract
         value — the caller published weights for a different model
-        config, which would silently retrace every bucket."""
-        new_params = jax.tree.map(jnp.asarray, new_params)
+        config (or a differently-placed tree), which would silently
+        retrace every bucket."""
+        new_params = self._place(new_params)
         old_def, old_leaves = _tree_spec(self._params)
         new_def, new_leaves = _tree_spec(new_params)
         if old_def != new_def or old_leaves != new_leaves:
             raise ValueError(
                 "hot swap rejected: published params do not match the "
-                "served model's tree/shapes/dtypes (a swap must never "
-                f"retrace). served={old_leaves[:3]}... got={new_leaves[:3]}..."
+                "served model's tree/shapes/dtypes/shardings (a swap "
+                "must never retrace). "
+                f"served={old_leaves[:3]}... got={new_leaves[:3]}..."
             )
         with self._lock:
             self._params = new_params

@@ -103,6 +103,7 @@ class ServingEngine:
         self.batcher = MicroBatcher(
             self.admission.queue, self.max_batch, self.batch_wait_s,
             self.bucket_policy,
+            shard_multiple=int(getattr(endpoint, "shard_multiple", 1)),
         )
         self._stop_evt = threading.Event()
         self._paused = threading.Event()
@@ -156,6 +157,20 @@ class ServingEngine:
                 self.admission.shed(
                     req, "stopped", ServingShedError("serving engine stopped")
                 )
+
+    def alive(self) -> bool:
+        """Is the worker thread serving? False before ``start``, after
+        ``stop`` and after a worker crash — the fleet's routing
+        excludes dead engines on exactly this."""
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and not self._stop_evt.is_set()
+        )
+
+    def depth(self) -> int:
+        """Queued (not yet drained) requests — the fleet's load signal."""
+        return self.admission.depth()
 
     def __enter__(self) -> "ServingEngine":
         return self.start()

@@ -112,7 +112,7 @@ PHASES = [
     # single_device_only — real scaling needs a pod slice window)
     ("multichip", ["--phase", "multichip"], 420.0),
     ("telemetry", ["--phase", "telemetry"], 300.0),
-    ("serving", ["--phase", "serving"], 300.0),
+    ("serving", ["--phase", "serving"], 420.0),  # + mesh/fleet variants
     ("tracing", ["--phase", "tracing"], 300.0),
     ("defense", ["--phase", "defense"], 420.0),
     ("chaosplan", ["--phase", "chaosplan"], 480.0),
@@ -171,6 +171,17 @@ def _perf_column(result: dict) -> str:
     )
     if wire is not None:
         bits.append(f"wire {wire:.1%}")
+    # serving fleet liveness: routing skew, deepest queue, micro-batch
+    # occupancy — the detail.serving fleet block when the phase ran
+    skew = _find_num(result, ("load_skew",))
+    if skew is not None:
+        bits.append(f"skew {skew:.1f}x")
+    depth = _find_num(result, ("depth_max",))
+    if depth is not None:
+        bits.append(f"depth {depth:.0f}")
+    occ = _find_num(result, ("occupancy_frac",))
+    if occ is not None:
+        bits.append(f"occ {occ:.0%}")
     return " | ".join(bits) if bits else "no perf readout"
 
 

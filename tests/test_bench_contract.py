@@ -73,14 +73,18 @@ class TestSchema:
 
     def test_serving_phase_contract(self):
         """detail.serving ships the serving-plane latency/throughput
-        figures: the phase is in the child vocabulary and the parent
-        stitches it (like pipeline/telemetry, it runs demoted on the
-        CPU fallback)."""
+        figures plus the mesh/fleet variants (bitwise-identical
+        responses across mesh shapes, load-aware fleet routing): the
+        phase is in the child vocabulary, the parent stitches it, and
+        the child forces 8 virtual host devices so the (2,2) submesh
+        exists on the CPU fallback."""
         assert "serving" in bench.PHASE_CHOICES
         import inspect
 
         parent = inspect.getsource(bench._main_guarded)
         assert '"serving"' in parent or "'serving'" in parent
+        child = inspect.getsource(bench._phase_main)
+        assert 'if a.phase == "serving"' in child
 
     def test_chaos_phase_contract(self):
         """detail.chaos ships the fault-tolerance evidence (exactly-once
@@ -288,6 +292,27 @@ class TestPhaseChild:
         assert d["swaps"] >= 2
         assert d["one_trace_per_bucket"] is True
         assert d["shed_queue_full"] > 0
+        # mesh variant: the SAME requests at two mesh shapes, bitwise-
+        # identical responses across 2 mid-run hot swaps, one trace
+        # per serve bucket per shape
+        mesh = d["mesh"]
+        assert len(mesh["shapes"]) >= 2, mesh
+        for key, s in mesh["shapes"].items():
+            assert s["swaps"] == 2, (key, s)
+            assert s["one_trace_per_bucket"] is True, (key, s)
+            assert s["p99_ms"] > 0 and s["req_per_sec"] > 0
+        assert mesh["max_abs_diff_across_shapes"] == 0.0
+        assert mesh["bitwise_identical_across_shapes"] is True
+        # fleet variant: two endpoints behind one door, load-aware
+        # routing within the 2x skew gate, a mid-run fleet-wide swap
+        fleet = d["fleet"]
+        assert fleet["endpoints"] == 2
+        assert sum(fleet["routed"]) > 0
+        assert fleet["load_skew"] <= 2.0
+        assert fleet["depth_max"] >= 1
+        assert fleet["occupancy_frac"] is None or fleet["occupancy_frac"] > 0
+        assert fleet["swaps"] >= 1
+        assert fleet["p99_ms"] > 0 and fleet["req_per_sec"] > 0
 
     @pytest.mark.slow  # ~15s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's chaos smoke block
