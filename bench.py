@@ -81,7 +81,7 @@ _CAPTURE_BASENAME = "BENCH_TPU_CAPTURE_r05.json"
 PHASE_CHOICES = (
     "headline", "bf16", "dense", "sweep", "longctx", "mesh", "pipeline",
     "telemetry", "serving", "chaos", "tracing", "straggler", "defense",
-    "chaosplan", "planet", "hier", "multichip", "crossdevice",
+    "chaosplan", "planet", "hier", "multichip", "crossdevice", "elastic",
 )
 
 # round-pipeline depths the pipeline phase measures; the contract key
@@ -2955,6 +2955,258 @@ def run_multichip(on_cpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def _build_elastic_world(
+    mesh_shape, cohort, rounds, n_clients, ckpt_dir=None, devices=None
+):
+    """One fed-mesh world on the multichip mini-config plus the elastic
+    knobs: a durable checkpoint dir, and (for the resume world) an
+    explicit SURVIVING device subset — ``build_fed_mesh(devices=...)``
+    over the survivors is exactly what a restarted process does after
+    chip loss, so the bench builds its resume world the same way."""
+    import fedml_tpu
+    from fedml_tpu import models
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data import load
+    from fedml_tpu.parallel.layout import build_fed_mesh
+    from fedml_tpu.simulation import SimulatorMesh
+
+    args = Arguments()
+    for k, v in dict(
+        dataset="mnist",
+        synthetic_train_size=n_clients * 40,
+        synthetic_test_size=200,
+        model="lr",
+        partition_method="hetero",
+        client_num_in_total=n_clients,
+        client_num_per_round=cohort,
+        comm_round=rounds,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.05,
+        frequency_of_the_test=10**9,
+        shuffle=False,
+        matmul_precision="default",
+        mesh_shape=mesh_shape,
+    ).items():
+        setattr(args, k, v)
+    if ckpt_dir is not None:
+        args.checkpoint_dir = ckpt_dir
+    args._validate()
+    args = fedml_tpu.init(args)  # flips threefry BEFORE the data loads
+    dataset = load(args)
+    model = models.create(args, dataset.class_num)
+    mesh = (
+        build_fed_mesh(devices=devices, mesh_shape=mesh_shape)
+        if devices is not None
+        else None
+    )
+    return SimulatorMesh(args, None, dataset, model, mesh=mesh)
+
+
+def run_elastic(on_cpu: bool, smoke: bool = False) -> dict:
+    """Elastic-mesh preemption phase (parallel/elastic.py +
+    fedavg_api's preempt/restore seam, docs/robustness.md device-loss
+    section) — survive chip loss with bitwise-identical resume on a
+    reshaped mesh:
+
+    - a scripted mid-round preemption (``SimulatedPreemption`` at round
+      1) drains the in-flight round, appends a WAL ``kind="preempt"``
+      record write-ahead of a forced checkpoint, and exits via
+      ``Preempted``;
+    - a restarted world over HALF the devices (8 -> 4 forced under
+      ``--cpu``) restores device-direct onto the surviving mesh,
+      appends the paired ``kind="resume"`` record, and completes the
+      run — final params must be **bitwise identical**
+      (``max_abs_diff == 0.0``) to an uninterrupted full-device run
+      (the PR-15 mesh-shape identity is what makes this provable);
+    - streaming-accumulator limbs travel across the reshape
+      (``export_state`` -> ``reshape_limb_state`` -> ``fold_limbs``)
+      bitwise-identically for raw AND int8-encoded uplinks;
+    - the offline ``InvariantChecker`` re-verifies the preempt/resume
+      WAL pairing on the run's artifacts;
+    - **recovery_s** (headline): wall time from starting the restarted
+      process's world build to its FIRST completed round — restore +
+      reshape + recompile included.
+
+    ``smoke`` (CI gate): cohort 16, 4 rounds, 32 clients."""
+    import tempfile as _tempfile
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.core.aggregation import StreamingAccumulator
+    from fedml_tpu.core.checkpoint import RoundWAL
+    from fedml_tpu.core.compression import Int8Codec
+    from fedml_tpu.core.invariants import InvariantChecker
+    from fedml_tpu.parallel.elastic import (
+        Preempted,
+        SimulatedPreemption,
+        reshape_limb_state,
+    )
+    from fedml_tpu.parallel.layout import shard_tree
+
+    n = len(jax.devices())
+    nb = 8 if n >= 8 else max(n - n % 2, 1)  # devices before the loss
+    na = max(nb // 2, 1)  # survivors
+    cohort = 16 if smoke else 32
+    if cohort % nb:
+        cohort = 2 * nb
+    rounds = 4
+    n_clients = max(2 * cohort, 32)
+    out = {
+        "n_devices": n,
+        "devices_before": nb,
+        "devices_after": na,
+        "cohort_size": cohort,
+        "rounds": rounds,
+        "device": str(jax.devices()[0]),
+    }
+    if nb == na:
+        out["single_device_only"] = True
+    shape_before = {"data": nb, "fsdp": 1}
+    shape_after = {"data": na, "fsdp": 1}
+
+    # 1) the uninterrupted reference: full device set, all rounds
+    _progress(f"elastic: uninterrupted {nb}-device baseline")
+    sim0 = _build_elastic_world(shape_before, cohort, rounds, n_clients)
+    sim0.run()
+    base = jax.tree.map(np.asarray, sim0.fl_trainer.global_params)
+
+    # 2) the preempted run: same world + checkpoint dir, a maintenance
+    # notice at round 1 -> WAL preempt record, forced checkpoint,
+    # controlled exit
+    ckpt_dir = _tempfile.mkdtemp(prefix="bench_elastic_")
+    _progress(f"elastic: preempted {nb}-device run (notice at round 1)")
+    sim1 = _build_elastic_world(
+        shape_before, cohort, rounds, n_clients, ckpt_dir=ckpt_dir
+    )
+    sim1.fl_trainer._preempt_signal = SimulatedPreemption(at_round=1)
+    try:
+        sim1.run()
+        out["preempted"] = False  # signal never fired — a failure
+    except Preempted as e:
+        out["preempted"] = True
+        out["preempt_round"] = int(e.round_idx)
+        out["preempt_reason"] = e.notice.reason
+
+    # 3) the restart: HALF the devices survive; restore lands
+    # device-direct on the reshaped mesh and the run completes.
+    # recovery_s clocks the whole restart (world build + restore +
+    # recompile) to the first completed round — the metric an operator
+    # actually waits on.
+    class _FirstRoundProbe:
+        t = None
+
+        def poll(self, round_idx):
+            if self.t is None:
+                self.t = time.perf_counter()
+            return None
+
+    _progress(f"elastic: resuming on {na} surviving devices")
+    t0 = time.perf_counter()
+    sim2 = _build_elastic_world(
+        shape_after,
+        cohort,
+        rounds,
+        n_clients,
+        ckpt_dir=ckpt_dir,
+        devices=list(jax.devices())[:na],
+    )
+    probe = _FirstRoundProbe()
+    sim2.fl_trainer._preempt_signal = probe
+    sim2.run()
+    recovery_s = (probe.t or time.perf_counter()) - t0
+    resumed = jax.tree.map(np.asarray, sim2.fl_trainer.global_params)
+    diff = max(
+        float(abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(resumed))
+    )
+    out["max_abs_diff_resume"] = diff
+    out["resume_identical"] = diff == 0.0
+    out["recovery_s"] = round(recovery_s, 3)
+    out["value"] = round(recovery_s, 3)
+    out["metric"] = "recovery_s"
+    out["unit"] = "s"
+    _progress(
+        f"elastic: resume diff {diff}, recovery {out['recovery_s']}s"
+    )
+
+    # 4) limb travel across the reshape: fold half the uploads on the
+    # BEFORE mesh, export the 3-limb expansion, reshard it onto the
+    # AFTER mesh, fold the rest there — finalize must equal the
+    # single-mesh fold of all four, raw AND int8 (the accumulator state
+    # is what the elastic checkpoint carries, so its portability is a
+    # bitwise contract, not a best effort)
+    mesh_b, mesh_a = sim1.mesh, sim2.mesh
+    rng = np.random.RandomState(7)
+    host = jax.tree.map(np.asarray, resumed)
+    ups = [
+        jax.tree.map(
+            lambda x: x + np.asarray(
+                rng.standard_normal(x.shape), x.dtype
+            ) * 0.01,
+            host,
+        )
+        for _ in range(4)
+    ]
+    ws = [float(w) for w in rng.randint(1, 9, size=4)]
+
+    def travel_diff(fold_one):
+        """max |single-mesh fold of 0..3  -  split fold (0,1 on the
+        before-mesh, limbs travel, 2,3 on the after-mesh)|."""
+        ref = StreamingAccumulator(shard_tree(ups[0], mesh_b))
+        for i in range(4):
+            fold_one(ref, i, mesh_b)
+        acc_b = StreamingAccumulator(shard_tree(ups[0], mesh_b))
+        for i in (0, 1):
+            fold_one(acc_b, i, mesh_b)
+        state = reshape_limb_state(acc_b.export_state(), mesh_a)
+        acc_a = StreamingAccumulator(shard_tree(ups[0], mesh_a))
+        acc_a.fold_limbs(
+            state["limbs"], state["total_w"], count=state["count"]
+        )
+        for i in (2, 3):
+            fold_one(acc_a, i, mesh_a)
+        return max(
+            float(abs(np.asarray(x) - np.asarray(y)).max())
+            for x, y in zip(
+                jax.tree.leaves(ref.finalize()),
+                jax.tree.leaves(acc_a.finalize()),
+            )
+        )
+
+    out["max_abs_diff_limbs_raw"] = travel_diff(
+        lambda acc, i, mesh: acc.fold(shard_tree(ups[i], mesh), ws[i])
+    )
+    codec = Int8Codec()
+    encs = [codec.encode(jax.tree.map(lambda x: x * 0.01, u)) for u in ups]
+    out["max_abs_diff_limbs_int8"] = travel_diff(
+        lambda acc, i, mesh: acc.fold_encoded(
+            codec, encs[i], shard_tree(ups[0], mesh), ws[i]
+        )
+    )
+    out["limb_travel_raw_identical"] = out["max_abs_diff_limbs_raw"] == 0.0
+    out["limb_travel_int8_identical"] = out["max_abs_diff_limbs_int8"] == 0.0
+    _progress(
+        f"elastic: limb travel raw diff {out['max_abs_diff_limbs_raw']}, "
+        f"int8 diff {out['max_abs_diff_limbs_int8']}"
+    )
+
+    # 5) the offline checker re-verifies the preempt/resume ledger on
+    # the run's own artifacts — same gate `fedml-tpu check` applies
+    out["wal_kinds"] = [
+        r.get("kind") for r in RoundWAL(ckpt_dir).records()
+    ]
+    rep = InvariantChecker(None, ckpt_dir).check()
+    out["invariants_ok"] = rep.ok
+    out["invariants_checked"] = list(rep.checked)
+    if not rep.ok:
+        out["invariant_violations"] = list(rep.violations)
+    if on_cpu:
+        out["cpu_fallback"] = True
+    return out
+
+
 def run_hier(on_cpu: bool, smoke: bool = False) -> dict:
     """Hierarchical server plane phase (docs/hierarchical.md): edge
     aggregators as REAL ranks over the comm seam.
@@ -3838,6 +4090,11 @@ _MULTICHIP_TIMEOUT_S = 420.0
 # numpy field math dominates, jit compiles are per-(tier, bucket) on
 # a tiny linear model
 _CROSSDEVICE_TIMEOUT_S = 480.0
+# three fed-mesh worlds (uninterrupted baseline, preempted run, the
+# 4-device restart) — each pays a sharded compile on the 8-virtual-
+# device box, and the restart deliberately recompiles for the
+# reshaped mesh (that recompile IS the recovery metric)
+_ELASTIC_TIMEOUT_S = 420.0
 _BF16_TIMEOUT_S = 90.0
 _LONGCTX_TIMEOUT_S = 110.0
 _MESH_TIMEOUT_S = 90.0
@@ -4154,6 +4411,12 @@ def _main_guarded() -> None:
     # params bitwise-identical to the unmasked twin, exactly-once fold
     # ledger matching the counters, offline invariant checker green
     _run_demoted_phase("crossdevice", _CROSSDEVICE_TIMEOUT_S)
+    # elastic-mesh preemption phase (parallel/elastic.py): a scripted
+    # mid-run preemption with an 8 -> 4 device reshape must resume
+    # bitwise identical to the uninterrupted run, limbs travel across
+    # the reshape for raw + int8, and the recovery wall time is the
+    # headline
+    _run_demoted_phase("elastic", _ELASTIC_TIMEOUT_S)
 
     if tpu_ok:
         # scaling sweep, one isolated child per cohort; 256 last so a
@@ -4277,8 +4540,12 @@ def _phase_main(argv) -> None:
         # devices (more drowns the 1-core box in collective emulation);
         # multichip forces the full 8-device (data, fsdp) world (the
         # LR model keeps collective emulation cheap); serving needs 8
-        # too for its (1,1)-vs-(2,2) mesh-endpoint submeshes; others 1
+        # too for its (1,1)-vs-(2,2) mesh-endpoint submeshes; elastic
+        # needs 8 so the scripted loss is a real 8 -> 4 reshape;
+        # others 1
         if a.phase == "serving":
+            _force_cpu(8)
+        elif a.phase == "elastic":
             _force_cpu(8)
         else:
             _force_cpu(
@@ -4318,6 +4585,8 @@ def _phase_main(argv) -> None:
         out = run_multichip(on_cpu=a.cpu, smoke=a.smoke)
     elif a.phase == "crossdevice":
         out = run_crossdevice(on_cpu=a.cpu, smoke=a.smoke)
+    elif a.phase == "elastic":
+        out = run_elastic(on_cpu=a.cpu, smoke=a.smoke)
     else:
         out = run_sweep_cohort(a.cohort)
     if isinstance(out, dict):

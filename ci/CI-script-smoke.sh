@@ -180,3 +180,18 @@ python -m pytest \
 python -m pytest \
   "tests/test_bench_contract.py::TestPhaseChild::test_crossdevice_smoke_child_writes_valid_json" \
   -q -p no:cacheprovider
+
+# Elastic-mesh preemption smoke (8 forced host devices, cohort 16 x 4
+# rounds, CPU): the preemption-tolerance seam must run end-to-end
+# through bench.py's elastic phase child and emit the detail.elastic
+# contract keys — a scripted maintenance notice at round 1 draining
+# the round, the WAL kind="preempt" record landing write-ahead of a
+# forced checkpoint, the restart on 4 surviving devices restoring
+# device-direct onto the reshaped mesh with the paired kind="resume"
+# record, final params bitwise identical (max_abs_diff == 0.0) to the
+# uninterrupted 8-device run, accumulator limbs traveling across the
+# reshape identically for raw AND int8 uplinks, the InvariantChecker
+# green on the preempt/resume ledger, and recovery_s in the headline.
+python -m pytest \
+  "tests/test_bench_contract.py::TestPhaseChild::test_elastic_smoke_child_writes_valid_json" \
+  -q -p no:cacheprovider

@@ -400,6 +400,19 @@ _DEFAULTS: Dict[str, Any] = {
     # register as — one misconfigured hello must not bloat the server
     # with ghost ranks
     "max_clients": 4096,
+    # elastic preemption signal (parallel/elastic.py): None/"none"
+    # disables; "round:K" fires a scripted maintenance drill at round
+    # K; "file:PATH" fires when PATH exists (external supervisor);
+    # "metadata" polls the GCE metadata maintenance-event endpoint
+    # (real TPU VMs); "chaos" rides a scheduled preempt/device.loss
+    # fault on the elastic.check event. Requires checkpoint_dir: a
+    # notice with nowhere durable to land is a config error, not a
+    # runtime surprise
+    "preempt_signal": None,
+    # elastic resume floor: refuse to resume on fewer surviving
+    # devices than this (below it the operator wants a page, not a
+    # crawl) — enforced by parallel/elastic.surviving_mesh
+    "elastic_min_devices": 1,
     # ---- scenario / model-geometry knobs (schema burn-down) ---------
     # Every knob below was read via getattr(...) with an inline
     # fallback but had no schema entry (the lint suite's registry
@@ -696,6 +709,32 @@ class Arguments:
             raise ValueError(
                 f"chaos_seed={raw!r}: must be an integer"
             ) from None
+        # -- elastic preemption knobs (docs/robustness.md device loss) --
+        from .parallel.elastic import make_signal
+
+        # parse-validate (the factory raises the naming ValueError);
+        # the parsed signal is rebuilt at train() time, not stored here
+        signal = make_signal(getattr(self, "preempt_signal", None))
+        if signal is not None and not getattr(self, "checkpoint_dir", None):
+            raise ValueError(
+                f"preempt_signal={self.preempt_signal!r} needs "
+                "checkpoint_dir: a preemption notice forces a durable "
+                "checkpoint — with nowhere to land it the drained round "
+                "would be lost"
+            )
+        raw = getattr(self, "elastic_min_devices", 1)
+        try:
+            self.elastic_min_devices = int(raw if raw is not None else 1)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"elastic_min_devices={raw!r}: must be an integer >= 1"
+            ) from None
+        if self.elastic_min_devices < 1:
+            raise ValueError(
+                f"elastic_min_devices={self.elastic_min_devices}: must be "
+                ">= 1 (the resume floor — below it the run refuses to "
+                "continue)"
+            )
         # -- defense / attack knobs (docs/robustness.md threat model) --
         defense = getattr(self, "defense_type", None) or None
         if defense is not None and defense not in constants.DEFENSE_TYPES:

@@ -73,6 +73,7 @@ __all__ = [
     "maybe_install_chaos",
     "chaos_barrier",
     "device_event",
+    "elastic_event",
     "comm_plan",
     "enumerate_crash_points",
     "crash_point_schedule",
@@ -105,7 +106,7 @@ class ProcessKilled(Exception):
 # detected fault
 EVENTS = (
     "send", "wal_create", "wal_append", "ckpt_publish", "barrier",
-    "device.checkin", "device.upload",
+    "device.checkin", "device.upload", "elastic.check",
 )
 
 # fault kinds by the exact event they apply to — a (kind, event) pair
@@ -129,6 +130,13 @@ _EVENT_FAULTS = {
     # the Shamir share this device later reveals for a vanished masker
     "device.checkin": ("vanish",),
     "device.upload": ("vanish", "bad_share"),
+    # elastic preemption: the round-boundary signal poll
+    # (parallel/elastic.ChaosPreemption). "preempt" is a scheduled
+    # maintenance eviction, "device.loss" a chip dying — both drain the
+    # round and force a durable exit; ONLY this event's adapter can
+    # apply them (a preempt scheduled on a barrier would fire-and-apply
+    # nothing, so validation rejects the pair)
+    "elastic.check": ("preempt", "device.loss"),
 }
 _ALL_FAULTS = tuple(sorted({k for ks in _EVENT_FAULTS.values() for k in ks}))
 
@@ -145,6 +153,7 @@ _EVENT_MATCHERS = {
     "barrier": ("name", "round", "rank"),
     "device.checkin": ("device", "round"),
     "device.upload": ("device", "round"),
+    "elastic.check": ("round",),
 }
 _MATCH_KEYS = ("round", "rank", "msg_type", "name", "kind", "device")
 
@@ -447,6 +456,24 @@ def device_event(
     return hits[0] if hits else None
 
 
+def elastic_event(round: Optional[int] = None) -> Optional[dict]:  # noqa: A002
+    """Consult the schedule at the round-boundary preemption poll
+    (``elastic.check``). Returns the fired fault mapping (``kind`` is
+    ``"preempt"`` / ``"device.loss"``) or None; the ELASTIC PLANE
+    interprets it — the signal seam turns it into a drained round, a
+    WAL preempt record and a forced checkpoint, never an exception at
+    the poll site (``parallel/elastic.ChaosPreemption``). No-op (one
+    dict lookup) when no schedule is installed."""
+    sched = _ACTIVE
+    if sched is None:
+        return None
+    ctx: Dict[str, Any] = {}
+    if round is not None:
+        ctx["round"] = int(round)
+    hits = sched.on_event("elastic.check", **ctx)
+    return hits[0] if hits else None
+
+
 def _apply_clock_skew(skew_s: float) -> None:
     """Step this process's WALL clock anchor (an NTP-step analog): the
     flight recorder's cross-shard alignment anchor moves, so the trace
@@ -650,7 +677,8 @@ class RecordingIO:
 
     def wal_append(self, path: str, data: bytes, **ctx) -> None:
         self._note(
-            "wal_append", round=ctx.get("round_idx"), nbytes=len(data)
+            "wal_append", round=ctx.get("round_idx"),
+            kind=ctx.get("kind"), nbytes=len(data),
         )
         self._real.wal_append(path, data, **ctx)
 

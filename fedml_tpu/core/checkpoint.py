@@ -326,6 +326,15 @@ class CheckpointWatcher:
     fails to restore is remembered as bad and never retried, so the
     poll loop cannot wedge on it; the newest older step that restores
     is returned instead.
+
+    Elastic contract: a restore target that no longer matches the
+    published state — the endpoint re-meshed onto a degraded device
+    set, or the trainer changed the state tree across a preemption —
+    must be RELEARNED, not treated as a corrupt step: the poll retries
+    the same step target-free (raw host restore), delivers it, and
+    counts ``serving_restore_target_relearned_total`` so the
+    subscriber (the fleet refreshes its target from each publish) can
+    re-derive placement. Only a step that fails BOTH ways is bad.
     """
 
     def __init__(
@@ -367,18 +376,48 @@ class CheckpointWatcher:
             (s for s in steps if s > floor and s not in self._bad),
             reverse=True,
         ):
+            target = None
             try:
                 # the target lookup stays INSIDE the try: a target that
                 # no longer matches a (stale) step must degrade to the
                 # previous version exactly like a corrupt step does
-                state = self.ckpt.restore(step, target=self._target())
-            except Exception:  # noqa: BLE001 — corrupt/partial: fall back
-                logging.exception(
-                    "checkpoint watcher: step %d failed to restore; "
-                    "falling back to the previous version", step,
-                )
-                self._bad.add(step)
-                continue
+                target = self._target()
+                state = self.ckpt.restore(step, target=target)
+            except Exception:  # noqa: BLE001 — mismatch OR corrupt
+                if target is not None:
+                    # a shaped target can fail for a reason a raw
+                    # restore cannot: the layout it describes is stale
+                    # (the endpoint re-meshed after device loss). Retry
+                    # target-free before declaring the STEP bad — only
+                    # a step that is unreadable either way is corrupt.
+                    try:
+                        state = self.ckpt.restore(step, target=None)
+                    except Exception:  # noqa: BLE001 — truly corrupt
+                        logging.exception(
+                            "checkpoint watcher: step %d failed to "
+                            "restore; falling back to the previous "
+                            "version", step,
+                        )
+                        self._bad.add(step)
+                        continue
+                    from .telemetry import Telemetry
+
+                    Telemetry.get_instance().inc(
+                        "serving_restore_target_relearned_total"
+                    )
+                    logging.warning(
+                        "checkpoint watcher: restore target no longer "
+                        "matches step %d (re-meshed endpoint?); "
+                        "delivered raw for the subscriber to relearn "
+                        "placement", step,
+                    )
+                else:
+                    logging.exception(
+                        "checkpoint watcher: step %d failed to restore; "
+                        "falling back to the previous version", step,
+                    )
+                    self._bad.add(step)
+                    continue
             if state is None:
                 self._bad.add(step)
                 continue

@@ -51,6 +51,17 @@ edge_merge_exactly_once round_wal + telemetry    hier_edge_merges_total ==
 edge_subledger_         round_wal + edge_*/      every merged edge set has a
   consistent            round_wal.jsonl          matching write-ahead record
                                                  in that edge's sub-ledger
+preempt_paired_with_    round_wal.jsonl          every kind="preempt" record
+  checkpoint                                     names a durable ckpt_step
+                                                 and is answered by a
+                                                 kind="resume" on the same
+                                                 step (a trailing preempt —
+                                                 not yet resumed — is legal)
+preempt_resume_         round_wal.jsonl          resume continues at exactly
+  continuity                                     preempt.round_idx + 1 (no
+                                                 round retrained or lost
+                                                 across the mesh reshape);
+                                                 no resume without a preempt
 ======================  =======================  =========================
 
 Counter-based invariants read the final snapshot per rank; in a LOCAL
@@ -270,6 +281,7 @@ class InvariantChecker:
             self._check_wal_shape(rep, sync, publishes)
             self._check_cohorts(rep, sync)
             self._check_round_monotone(rep, sync)
+            self._check_preempt(rep, sync)
             self._check_async(rep, publishes)
         self._check_counters(rep, sync, publishes)
         self._check_chaos_trace(rep)
@@ -511,6 +523,86 @@ class InvariantChecker:
                     )
                 prev_step = int(step)
                 durable_steps.add(int(step))
+
+    def _check_preempt(self, rep, sync) -> None:
+        """The elastic plane's durable-exit contract, from artifacts
+        (``parallel/elastic.py``): a ``kind="preempt"`` record is a
+        PROMISE — "round R drained, checkpoint step S holds it" — and
+        the paired ``kind="resume"`` record is the evidence the promise
+        was kept: some later incarnation restored that step (possibly
+        onto a reshaped mesh) and continued at exactly round R + 1, so
+        no round was retrained or lost across the device loss. A
+        trailing preempt (the final WAL word) is legal — the run is
+        simply still down — but a preempt answered by anything other
+        than its resume, or a resume with no preempt to answer, is a
+        ledger bug."""
+        preempts = [
+            (i, r) for i, r in enumerate(sync) if r.get("kind") == "preempt"
+        ]
+        resumes = [
+            (i, r) for i, r in enumerate(sync) if r.get("kind") == "resume"
+        ]
+        if not preempts and not resumes:
+            rep.skip(
+                "preempt_paired_with_checkpoint", "no preempt/resume records"
+            )
+            rep.skip("preempt_resume_continuity", "no preempt/resume records")
+            return
+        rep.note_checked("preempt_paired_with_checkpoint")
+        rep.note_checked("preempt_resume_continuity")
+        answered: set = set()
+        for i, rec in preempts:
+            step = rec.get("ckpt_step")
+            if not isinstance(step, int):
+                rep.fail(
+                    "preempt_paired_with_checkpoint",
+                    f"preempt record {i} (round {rec['round_idx']}) names "
+                    "no checkpoint step — the forced save never made the "
+                    "drained round durable",
+                )
+                continue
+            if i == len(sync) - 1:
+                continue  # trailing preempt: resume hasn't happened yet
+            nxt = sync[i + 1]
+            if nxt.get("kind") != "resume":
+                rep.fail(
+                    "preempt_paired_with_checkpoint",
+                    f"preempt record {i} (round {rec['round_idx']}) is "
+                    f"followed by a {nxt.get('kind') or 'round'} record, "
+                    "not its resume — the run continued without restoring "
+                    "the preemption checkpoint",
+                )
+                continue
+            answered.add(i + 1)
+            if int(nxt.get("ckpt_step") or -1) != step:
+                rep.fail(
+                    "preempt_paired_with_checkpoint",
+                    f"resume record {i + 1} restored step "
+                    f"{nxt.get('ckpt_step')} but the preempt promised "
+                    f"step {step}",
+                )
+            if int(nxt["round_idx"]) != int(rec["round_idx"]) + 1:
+                rep.fail(
+                    "preempt_resume_continuity",
+                    f"resume record {i + 1} continues at round "
+                    f"{nxt['round_idx']} but the preempt drained round "
+                    f"{rec['round_idx']} — round "
+                    f"{int(rec['round_idx']) + 1} was "
+                    + (
+                        "retrained"
+                        if int(nxt["round_idx"]) <= int(rec["round_idx"])
+                        else "skipped"
+                    ),
+                )
+        for i, rec in resumes:
+            if i in answered:
+                continue
+            if i == 0 or sync[i - 1].get("kind") != "preempt":
+                rep.fail(
+                    "preempt_resume_continuity",
+                    f"resume record {i} (round {rec['round_idx']}) answers "
+                    "no preempt record — a resume out of nowhere",
+                )
 
     def _check_async(self, rep, publishes) -> None:
         if not publishes:

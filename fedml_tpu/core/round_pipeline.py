@@ -273,6 +273,7 @@ class RoundPipeline:
                 # i.e. exactly the synchronous loop's behavior)
                 flush(round_idx - (self.depth - 1))
 
+            saved = False
             if ckpt is not None and (
                 (round_idx + 1) % ckpt_freq == 0 or round_idx == comm_rounds - 1
             ):
@@ -281,6 +282,23 @@ class RoundPipeline:
                 flush(None)
                 api._save_checkpoint(ckpt, round_idx)
                 self._extra_syncs += 1
+                saved = True
+            signal = getattr(api, "_preempt_signal", None)
+            if signal is not None:
+                notice = signal.poll(round_idx)
+                if notice is not None:
+                    # drain the depth-K window DETERMINISTICALLY before
+                    # the forced snapshot: every in-flight round's
+                    # confirmation waited on (same barrier as the depth
+                    # bound), deferred metrics out — the checkpoint then
+                    # holds exactly the rounds the WAL says it does
+                    while inflight:
+                        jax.block_until_ready(inflight.popleft())  # lint: host-sync-ok — preempt drain (same barrier as the depth bound)
+                    flush(None)
+                    self._extra_syncs += 1
+                    from ..parallel.elastic import preempt_now
+
+                    preempt_now(api, ckpt, round_idx, notice, saved=saved)
 
         flush(None)  # drain
         n_rounds = max(1, comm_rounds - start_round)

@@ -432,11 +432,19 @@ class PlanetRoundLoop:
                 api.history.append(stats)
                 final_stats = stats
                 api.metrics_reporter.report_server_training_metric(stats)
+            saved = False
             if ckpt is not None and (
                 (round_idx + 1) % ckpt_freq == 0
                 or round_idx == comm_rounds - 1
             ):
                 api._save_checkpoint(ckpt, round_idx)
+                saved = True
+            # elastic seam: the registry round is fully drained here
+            # (finalize() collapsed the fold on host), so a notice
+            # forces a durable exit the reshaped-mesh restart resumes
+            # from — registry sampling is host-deterministic per round,
+            # so the resumed cohorts replay identically
+            api._maybe_preempt(ckpt, round_idx, saved=saved)
 
         self.stats = {
             "registry_clients": self.registry.size,

@@ -126,6 +126,9 @@ class ModelEndpoint:
                     "serve.jit_trace", cat="compile", bucket=bucket
                 )
 
+        # kept for re-jits (the mesh endpoint's remesh rebuilds the
+        # forward over a new mesh through the same trace-count seam)
+        self._on_trace = on_trace
         self._fwd = jax.jit(self._build_forward(on_trace))
 
     def _build_forward(self, on_trace):
@@ -175,7 +178,7 @@ class ModelEndpoint:
             )
         with self._lock:
             self._params = new_params
-            self.version = int(version) if version is not None else self.version + 1
+            self.version = int(version) if version is not None else self.version + 1  # lint: host-sync-ok — version is the publisher's python int, never a device array
             self.swaps += 1
             v = self.version
         from ..core.telemetry import Telemetry

@@ -224,7 +224,7 @@ class ServingEngine:
         is relative to now; ``deadline_ts`` is an absolute
         ``time.monotonic`` stamp (frontends pass the client's through
         so network delay eats into the budget)."""
-        x = np.asarray(x)
+        x = np.asarray(x)  # lint: host-sync-ok — request ingestion: callers hand host lists/ndarrays, not device values
         expected = tuple(self.endpoint.model.example_shape)
         if expected and tuple(x.shape) != expected:
             raise ValueError(
@@ -233,9 +233,9 @@ class ServingEngine:
             )
         now = time.monotonic()
         if deadline_ts is not None:
-            deadline = float(deadline_ts)
+            deadline = float(deadline_ts)  # lint: host-sync-ok — wall-clock deadline, a python float from the frontend
         elif deadline_s is not None:
-            deadline = now + float(deadline_s) if deadline_s > 0 else None
+            deadline = now + float(deadline_s) if deadline_s > 0 else None  # lint: host-sync-ok — wall-clock budget, a python float knob
         else:
             deadline = (
                 now + self.default_deadline_s
@@ -316,7 +316,7 @@ class ServingEngine:
             # async round dispatches, this is TRUE device+transfer time
             with _devtime("serving.forward", bucket=f"b{bucket}"):
                 y = self.endpoint.infer(padded)
-                host = np.asarray(y)  # ONE fetch per micro-batch
+                host = np.asarray(y)  # lint: host-sync-ok — the ONE deliberate fetch per micro-batch, measured by the devtime block above
         finally:
             if tel.enabled:
                 rec.end("serve.batch", cat="serving")

@@ -339,16 +339,21 @@ class ServingFleet:
 
     def publish_state(self, state: Dict[str, Any], step: int) -> int:
         """``CheckpointWatcher`` callback target: fan a published
-        checkpoint state out to every endpoint and learn the sharded
-        restore target from the first publish."""
+        checkpoint state out to every endpoint and refresh the sharded
+        restore target from it. Refreshing EVERY publish (not
+        learn-once) is the elastic contract: after ``remesh`` shrinks
+        the endpoints onto the surviving devices, the first publish the
+        watcher delivers (raw, after its relearn fallback —
+        ``serving_restore_target_relearned_total``) rebuilds the target
+        on the NEW mesh's shardings, so later restores land
+        device-direct again."""
         v = 0
         for e in self.engines:
             v = e.endpoint.swap_from_checkpoint_state(state, version=step)
-        if self._restore_target is None:
-            ep = self.engines[0].endpoint
-            build = getattr(ep, "restore_target", None)
-            if build is not None:
-                self._restore_target = build(state)
+        ep = self.engines[0].endpoint
+        build = getattr(ep, "restore_target", None)
+        if build is not None:
+            self._restore_target = build(state)
         if self.telemetry.enabled:
             self.telemetry.inc("serving_fleet_swaps_total")
         return v
@@ -359,6 +364,36 @@ class ServingFleet:
         the abstract mesh-sharded target — every later restore lands
         each param shard device-direct."""
         return self._restore_target
+
+    # -- elastic re-mesh ----------------------------------------------
+    def remesh(self, devices=None, mesh_shape=None) -> int:
+        """Re-mesh every mesh endpoint onto the surviving device set,
+        one engine at a time so the rest of the fleet keeps serving:
+        each engine is stopped (its queued requests shed TYPED and
+        counted — ``serving_shed_total{reason=stopped}`` — and routing
+        excludes the dead engine, so the stream flows around it),
+        its endpoint rebuilt over the new mesh, then restarted. The
+        stale sharded restore target is dropped so the watcher's
+        relearn path + the next publish re-derive it on the new
+        layout. Returns the number of endpoints re-meshed."""
+        n = 0
+        for e in self.engines:
+            ep = e.endpoint
+            if not hasattr(ep, "remesh"):
+                continue  # a plain single-device endpoint has no mesh
+            was_alive = e.alive()
+            if was_alive:
+                e.stop()
+            ep.remesh(devices=devices, mesh_shape=mesh_shape)
+            # the micro-batcher lifts buckets to the endpoint's lane
+            # count — a 8->4 reshape halves it, so rebind it too
+            e.batcher.shard_multiple = int(getattr(ep, "shard_multiple", 1))
+            if was_alive:
+                e.start()
+            n += 1
+        if n:
+            self._restore_target = None
+        return n
 
 
 class FleetFrontend(ServingFrontend):

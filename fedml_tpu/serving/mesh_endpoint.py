@@ -172,6 +172,46 @@ class MeshModelEndpoint(ModelEndpoint):
             self._last_published = int(version)
         return v
 
+    # -- elastic re-mesh -----------------------------------------------
+    def remesh(self, devices=None, mesh_shape=None) -> None:
+        """Rebuild this endpoint over the SURVIVING device set (the
+        elastic plane's serving half — a chip died, or the pod shrank):
+        a new (data, fsdp) mesh over ``devices``, the served params
+        re-placed onto it (``device_put`` reshard — device-to-device
+        where the runtime can), and the forward re-jitted over the new
+        mesh through the same trace-count seam. The response identity
+        across mesh shapes (module docstring) is what makes this safe:
+        the re-meshed endpoint answers bitwise identically.
+
+        Caller contract: quiesce the engine first (``stop()`` or
+        ``pause()``) — the fleet's ``remesh`` does, shedding queued
+        requests counted so the rest of the fleet absorbs the stream
+        while this endpoint rebuilds. Counted
+        ``serving_remesh_total``."""
+        from ..parallel.layout import build_fed_mesh
+
+        new_mesh = build_fed_mesh(devices=devices, mesh_shape=mesh_shape)
+        new_fwd = jax.jit(
+            build_mesh_forward(self.model.apply, new_mesh, self._on_trace)
+        )
+        with self._lock:
+            params = self._params
+        placed = shard_tree(params, new_mesh)
+        with self._lock:
+            self.mesh = new_mesh
+            self.shard_multiple = cohort_axis_size(new_mesh)
+            self._params = placed
+            self._fwd = new_fwd
+        from ..core.telemetry import Telemetry
+
+        tel = Telemetry.get_instance()
+        if tel.enabled:
+            tel.inc("serving_remesh_total")
+            tel.recorder.instant(
+                "serve.remesh", cat="serving",
+                devices=len(new_mesh.devices.flatten()),
+            )
+
     # -- device-direct publish -----------------------------------------
     def restore_target(self, state: Dict[str, Any]) -> Dict[str, Any]:
         """Build the ``CheckpointWatcher`` restore target from one

@@ -623,6 +623,17 @@ class FedAvgAPI:
         comm_rounds = int(args.comm_round)
         freq = max(1, int(getattr(args, "frequency_of_the_test", 5)))
         ckpt, start_round = self._maybe_restore()
+        if getattr(self, "_preempt_signal", None) is None:
+            # the elastic seam (parallel/elastic.py): tests and the
+            # bench inject a signal object directly; everyone else gets
+            # it from the preempt_signal knob (validated to require
+            # checkpoint_dir, so a notice always has somewhere durable
+            # to land)
+            from ..parallel.elastic import make_signal
+
+            self._preempt_signal = make_signal(
+                getattr(args, "preempt_signal", None)
+            )
         # stall watchdog (core/telemetry.py): armed only when
         # args.stall_timeout_s > 0; observes the pipeline/comm
         # heartbeats and dumps a debug bundle to args.telemetry_dir
@@ -748,12 +759,33 @@ class FedAvgAPI:
                 self.history.append(stats)
                 final_stats = stats
                 self.metrics_reporter.report_server_training_metric(stats)
+            saved = False
             if ckpt is not None and (
                 (round_idx + 1) % self._ckpt_freq == 0
                 or round_idx == comm_rounds - 1
             ):
                 self._save_checkpoint(ckpt, round_idx)
+                saved = True
+            self._maybe_preempt(ckpt, round_idx, saved=saved)
         return final_stats
+
+    # -- elastic preemption seam (parallel/elastic.py) ----------------
+    def _maybe_preempt(self, ckpt, round_idx: int, saved: bool = False) -> None:
+        """Poll the preemption signal at the round boundary; on notice,
+        make the drained round durable (WAL ``kind="preempt"``
+        write-ahead of a forced checkpoint) and raise ``Preempted`` —
+        the clean controlled exit a restart on the surviving devices
+        resumes from bitwise-identically. ``saved=True`` means the
+        cadence block already published this round's step."""
+        signal = getattr(self, "_preempt_signal", None)
+        if signal is None:
+            return
+        notice = signal.poll(int(round_idx))  # lint: host-sync-ok — round_idx is the host loop counter, never a device array
+        if notice is None:
+            return
+        from ..parallel.elastic import preempt_now
+
+        preempt_now(self, ckpt, int(round_idx), notice, saved=saved)  # lint: host-sync-ok — host loop counter (see poll above)
 
     # -- checkpoint / resume (new vs reference — SURVEY.md §5) --------
     def _maybe_restore(self):
@@ -769,21 +801,104 @@ class FedAvgAPI:
             1, int(getattr(self.args, "checkpoint_freq", None) or 10)
         )
         ckpt = RoundCheckpointer(ckpt_dir)
-        restored = ckpt.restore()
+        restored = self._restore_state(ckpt, to_state_dict)
         start_round = 0
         if restored is not None:
+            from ..parallel.layout import is_fed_mesh, shard_tree
+
             self.global_params = jax.tree.map(
                 jnp.asarray, from_state_dict(self.global_params, restored["params"])
             )
+            mesh = getattr(self, "mesh", None)
+            if mesh is not None and is_fed_mesh(mesh):
+                # elastic resume: land the restored params at-rest on
+                # the CURRENT (possibly reshaped) mesh — a raw-fallback
+                # restore leaves them committed to one device, which
+                # would pin every downstream jit there
+                self.global_params = shard_tree(self.global_params, mesh)
             self.server_state = from_state_dict(
                 self.server_state, restored["server_state"]
             )
-            self.rng = jnp.asarray(restored["rng"], dtype=jnp.uint32)
+            self.rng = jnp.asarray(
+                np.asarray(restored["rng"]),  # lint: host-sync-ok — restore-time scalar pair, once per run; breaks the restore's single-device commitment
+                dtype=jnp.uint32,
+            )
             start_round = int(restored["round_idx"]) + 1  # lint: host-sync-ok — restore-time scalar, once per run
             self._restore_extra_state(restored.get("extra"))
+            self._note_elastic_resume(ckpt, start_round)
             logging.info("resuming from round %d", start_round)
         self._to_state_dict = to_state_dict
         return ckpt, start_round
+
+    def _restore_state(self, ckpt, to_state_dict):
+        """Restore the latest step — device-direct onto the CURRENT
+        mesh layout when one exists (the elastic resume path: a run
+        preempted on 8 devices restores straight onto the surviving
+        4-device mesh's NamedShardings, no host staging of the full
+        model), raw host restore otherwise. A shaped target that the
+        saved tree refuses (structure drift across versions, an
+        ``extra`` block appearing/vanishing) falls back to the raw
+        restore rather than failing the resume."""
+        from ..parallel.layout import is_fed_mesh, shard_tree
+
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None and is_fed_mesh(mesh):
+            # the target's leaves carry the CURRENT mesh's at-rest
+            # NamedShardings, so orbax restores each param straight
+            # onto the surviving layout — no host staging of the model
+            target = {
+                "params": shard_tree(self.global_params, mesh),
+                "server_state": to_state_dict(self.server_state),
+                "rng": self.rng,
+                "round_idx": 0,
+            }
+            extra = self._extra_checkpoint_state()
+            if extra is not None:
+                target["extra"] = extra
+            try:
+                return ckpt.restore(target=target)
+            except Exception:  # noqa: BLE001 — shaped-restore drift
+                logging.warning(
+                    "mesh-targeted restore failed; retrying as raw "
+                    "host restore", exc_info=True,
+                )
+        return ckpt.restore()
+
+    def _note_elastic_resume(self, ckpt, start_round: int) -> None:
+        """If the WAL's last word was ``kind="preempt"``, this restore
+        IS the elastic resume: append the paired ``kind="resume"``
+        record (the invariant checker's restorability evidence —
+        ``preempt_paired_with_checkpoint``) and count it. A checkpoint
+        dir with no WAL (or a WAL ending in an ordinary round record)
+        is a plain restart — no record, no counter."""
+        from ..core.checkpoint import RoundWAL
+
+        wal = RoundWAL(ckpt.dir)
+        last = wal.last()
+        if last is None or last.get("kind") != "preempt":
+            return
+        from ..parallel.elastic import _mesh_devices, _mesh_shape
+
+        mesh = getattr(self, "mesh", None)
+        wal.append(
+            int(start_round),  # lint: host-sync-ok — restore-time python scalar, once per run
+            int(last.get("ckpt_step") or 0),  # lint: host-sync-ok — JSON field from the WAL, host-only
+            [],
+            kind="resume",
+            extra={
+                "devices": _mesh_devices(mesh),
+                "mesh_shape": _mesh_shape(mesh),
+            },
+        )
+        tel = getattr(self, "telemetry", None)
+        if tel is not None and tel.enabled:
+            tel.inc("elastic_resumes_total")
+        logging.warning(
+            "elastic resume: preempt record at round %s consumed; "
+            "continuing from round %d on %d device(s)",
+            last.get("round_idx"), int(start_round),  # lint: host-sync-ok — restore-time python scalar, once per run
+            len(_mesh_devices(mesh)) or 1,
+        )
 
     def _extra_checkpoint_state(self):
         """Algorithm-side host state to persist (S-FedAvg reputation)."""

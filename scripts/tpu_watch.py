@@ -121,6 +121,11 @@ PHASES = [
     # Beehive check-in plane: 100k registry, churned cohorts, masked
     # vs unmasked twin worlds + dropout recovery + fedml-tpu check
     ("crossdevice", ["--phase", "crossdevice"], 480.0),
+    # elastic-mesh preemption: scripted notice -> WAL preempt record ->
+    # forced checkpoint -> restart on half the devices, bitwise
+    # identical resume + limb travel; recovery_s is the headline (on a
+    # 1-chip tunnel it records single_device_only)
+    ("elastic", ["--phase", "elastic"], 420.0),
 ]
 MAX_ATTEMPTS = 3  # per phase, each in a fresh window
 

@@ -195,6 +195,23 @@ class TestSchema:
         parent = inspect.getsource(bench._main_guarded)
         assert '"hier"' in parent or "'hier'" in parent
 
+    def test_elastic_phase_contract(self):
+        """detail.elastic ships the elastic-mesh preemption evidence
+        (scripted mid-run preemption with an 8 -> 4 device reshape,
+        resume bitwise identical to the uninterrupted run, limb travel
+        across the reshape for raw + int8, preempt/resume WAL pairing
+        checked, recovery_s headline): the phase is in the child
+        vocabulary, the parent stitches it (like multichip, it runs
+        demoted on the CPU fallback), and the child forces 8 virtual
+        host devices so the scripted loss is a real reshape."""
+        assert "elastic" in bench.PHASE_CHOICES
+        import inspect
+
+        parent = inspect.getsource(bench._main_guarded)
+        assert '"elastic"' in parent or "'elastic'" in parent
+        child = inspect.getsource(bench._phase_main)
+        assert 'a.phase == "elastic"' in child
+
     def test_crossdevice_phase_contract(self):
         """detail.crossdevice ships the Beehive plane evidence (rounds
         closing on fold targets under 30% churn, masked fold bitwise
@@ -538,6 +555,42 @@ class TestPhaseChild:
         assert d["agg_stream_raw_identical"] is True
         assert d["agg_stream_int8_identical"] is True
         assert "simulation.round_fn_mesh" in d["mesh_executables_registered"]
+
+    @pytest.mark.slow  # ~15s bench child; the fast gate runs the same
+    # invocation once via ci/CI-script-smoke.sh's elastic smoke block
+    def test_elastic_smoke_child_writes_valid_json(self):
+        """The CI elastic smoke invocation (8 forced host devices,
+        cohort 16, 4 rounds, CPU): the elastic-mesh preemption seam
+        runs end-to-end through bench.py's elastic phase child and
+        emits the detail.elastic contract keys — a scripted
+        maintenance notice at round 1 drains the round, lands the WAL
+        ``preempt`` record write-ahead of a forced checkpoint and
+        exits; the restart on 4 surviving devices restores
+        device-direct onto the reshaped mesh, pairs the ``resume``
+        record, and finishes **bitwise identical**
+        (max_abs_diff == 0.0) to the uninterrupted 8-device run;
+        accumulator limbs travel across the reshape identically for
+        raw AND int8 uplinks; the InvariantChecker re-verifies the
+        preempt/resume ledger; recovery_s is the headline."""
+        d = self._run_child("elastic", 500, smoke=True)
+        assert d["n_devices"] == 8
+        assert d["devices_before"] == 8 and d["devices_after"] == 4
+        assert d["cohort_size"] == 16 and d["rounds"] == 4
+        assert d["preempted"] is True
+        assert d["preempt_round"] == 1
+        assert d["max_abs_diff_resume"] == 0.0
+        assert d["resume_identical"] is True
+        assert d["recovery_s"] > 0
+        assert d["metric"] == "recovery_s" and d["value"] == d["recovery_s"]
+        assert d["max_abs_diff_limbs_raw"] == 0.0
+        assert d["max_abs_diff_limbs_int8"] == 0.0
+        assert d["limb_travel_raw_identical"] is True
+        assert d["limb_travel_int8_identical"] is True
+        assert d["wal_kinds"] == ["preempt", "resume"]
+        assert d["invariants_ok"] is True
+        for inv in ("preempt_paired_with_checkpoint",
+                    "preempt_resume_continuity"):
+            assert inv in d["invariants_checked"]
 
     @pytest.mark.slow  # ~35s bench child; the fast gate runs the same
     # invocation once via ci/CI-script-smoke.sh's hier smoke block

@@ -26,6 +26,7 @@ from fedml_tpu.core.chaos import (
     comm_plan,
     crash_point_schedule,
     enumerate_crash_points,
+    elastic_event,
     install_chaos,
     maybe_install_chaos,
     reset_chaos,
@@ -132,6 +133,62 @@ class TestScheduleValidation:
             chaos_seed=7,
         )
         assert a.chaos_seed == 7
+
+
+class TestElasticCheckEvent:
+    """The elastic plane's chaos hook (``elastic.check``): preempt /
+    device.loss faults ride the deterministic schedule machinery, and
+    ONLY that event's adapter can apply them — everywhere else the
+    pair is inert and validation rejects it outright."""
+
+    def test_preempt_and_device_loss_validate_on_elastic_check(self):
+        steps = validate_schedule([
+            {"at": {"event": "elastic.check", "round": 2},
+             "fault": "preempt"},
+            {"at": {"event": "elastic.check"}, "fault": "device.loss"},
+        ])
+        assert steps[0]["fault"]["kind"] == "preempt"
+        assert steps[1]["fault"]["kind"] == "device.loss"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            # preempt/device.loss anywhere else would fire-and-apply
+            # nothing (a phantom fault) — rejected outright
+            [{"at": {"event": "barrier"}, "fault": "preempt"}],
+            [{"at": {"event": "send"}, "fault": "preempt"}],
+            [{"at": {"event": "wal_append"}, "fault": "device.loss"}],
+            [{"at": {"event": "ckpt_publish"}, "fault": "device.loss"}],
+            # elastic.check applies no other layer's kinds either
+            [{"at": {"event": "elastic.check"}, "fault": "drop"}],
+            [{"at": {"event": "elastic.check"}, "fault": "kill_server"}],
+            # and the only matcher its adapter supplies is `round`
+            [{"at": {"event": "elastic.check", "rank": 0},
+              "fault": "preempt"}],
+        ],
+    )
+    def test_inert_pairs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            validate_schedule(bad)
+
+    def test_elastic_event_adapter_fires_on_round_match(self):
+        reset_chaos()
+        install_chaos(ChaosSchedule([
+            {"at": {"event": "elastic.check", "round": 2},
+             "fault": "device.loss"},
+        ]))
+        try:
+            assert elastic_event(0) is None
+            assert elastic_event(1) is None
+            fault = elastic_event(2)
+            assert fault is not None and fault["kind"] == "device.loss"
+            assert elastic_event(2) is None  # one-shot
+        finally:
+            reset_chaos()
+
+    def test_elastic_event_noop_without_schedule(self):
+        reset_chaos()
+        assert elastic_event(0) is None
 
 
 class TestScheduleFiring:
